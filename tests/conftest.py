@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-virtual-device CPU platform.
+
+SURVEY.md §4's implication for the TPU build: a fake-mesh collective backend
+via `XLA_FLAGS=--xla_force_host_platform_device_count=8` gives single-process
+multi-device testing — strictly better than the reference's
+multi-process-only distributed test story. Must run before jax is imported.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

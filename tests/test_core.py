@@ -1,0 +1,170 @@
+"""Core IR tests: trace construction, printing, round-trip execution,
+DCE/CSE, proxies, dtype promotion.
+
+Modeled on the reference's thunder/tests/test_core.py (tracing, caching,
+proxies, codegen, transforms).
+"""
+
+import numpy as np
+import pytest
+
+import thunder_tpu as ttpu
+import thunder_tpu.clang as clang
+import thunder_tpu.core.prims as prims
+from thunder_tpu.core import dtypes, devices
+from thunder_tpu.core.proxies import TensorProxy, NumberProxy
+from thunder_tpu.core.trace import TraceCtx, tracectx
+from thunder_tpu.transforms.common import dce, cse
+
+
+def make_trace_add_mul():
+    trc = TraceCtx()
+    with tracectx(trc):
+        a = TensorProxy(shape=(4, 5), dtype=dtypes.float32, device=devices.Device("cpu"))
+        b = TensorProxy(shape=(4, 5), dtype=dtypes.float32, device=devices.Device("cpu"))
+        trc.args = (a, b)
+        c = clang.add(a, b)
+        d = clang.mul(c, c)
+        unused = clang.sub(a, b)  # dead
+        prims.python_return(d)
+        trc.output = d
+    return trc
+
+
+class TestTraceConstruction:
+    def test_trace_records_bsyms(self):
+        trc = make_trace_add_mul()
+        names = [b.sym.name for b in trc.bound_symbols]
+        assert "add" in names and "mul" in names and "python_return" in names
+
+    def test_trace_prints_as_python(self):
+        trc = make_trace_add_mul()
+        src = trc.python()
+        assert "def computation(t0, t1):" in src
+        assert "prims.add(t0, t1)" in src
+        assert "return" in src
+        compile(src, "<test>", "exec")  # must be valid Python
+
+    def test_proxy_names_unique(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            ps = [TensorProxy(shape=(1,), dtype=dtypes.float32, device=devices.cpu) for _ in range(10)]
+        assert len({p.name for p in ps}) == 10
+
+
+class TestTransforms:
+    def test_dce_removes_dead_code(self):
+        trc = make_trace_add_mul()
+        n_before = len(trc.bound_symbols)
+        trc2 = dce(trc)
+        assert len(trc2.bound_symbols) == n_before - 1
+        assert all(b.sym.name != "sub" for b in trc2.bound_symbols)
+
+    def test_cse_merges_duplicates(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = TensorProxy(shape=(3,), dtype=dtypes.float32, device=devices.cpu)
+            trc.args = (a,)
+            x = clang.sin(a)
+            y = clang.sin(a)
+            z = clang.add(x, y)
+            prims.python_return(z)
+            trc.output = z
+        trc2 = cse(trc)
+        sin_count = sum(1 for b in trc2.bound_symbols if b.sym.name == "sin")
+        assert sin_count == 1
+
+    def test_provenance_recorded(self):
+        trc2 = dce(make_trace_add_mul())
+        assert "Dead Code Elimination" in repr(trc2.provenance)
+
+
+class TestTypePromotion:
+    @pytest.mark.parametrize(
+        "da,db,expected",
+        [
+            (dtypes.float32, dtypes.bfloat16, dtypes.float32),
+            (dtypes.bfloat16, dtypes.float16, dtypes.float32),
+            (dtypes.int64, dtypes.float32, dtypes.float32),
+            (dtypes.int32, dtypes.int64, dtypes.int64),
+            (dtypes.bool8, dtypes.int8, dtypes.int8),
+        ],
+    )
+    def test_tensor_tensor(self, da, db, expected):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = TensorProxy(shape=(2,), dtype=da, device=devices.cpu)
+            b = TensorProxy(shape=(2,), dtype=db, device=devices.cpu)
+            out = clang.add(a, b)
+        assert out.dtype == expected
+
+    def test_number_does_not_promote_width(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = TensorProxy(shape=(2,), dtype=dtypes.bfloat16, device=devices.cpu)
+            out = clang.add(a, 2.0)
+            assert out.dtype == dtypes.bfloat16
+            out2 = clang.add(a, 2)
+            assert out2.dtype == dtypes.bfloat16
+
+    def test_float_number_promotes_int_tensor(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = TensorProxy(shape=(2,), dtype=dtypes.int32, device=devices.cpu)
+            out = clang.mul(a, 2.0)
+        assert out.dtype == dtypes.float32
+
+
+class TestMetaFunctions:
+    def test_matmul_shapes(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = TensorProxy(shape=(8, 4, 5), dtype=dtypes.float32, device=devices.cpu)
+            b = TensorProxy(shape=(5, 7), dtype=dtypes.float32, device=devices.cpu)
+            out = prims.matmul(a, b)
+        assert out.shape == (8, 4, 7)
+
+    def test_matmul_mismatch_raises(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = TensorProxy(shape=(4, 5), dtype=dtypes.float32, device=devices.cpu)
+            b = TensorProxy(shape=(4, 5), dtype=dtypes.float32, device=devices.cpu)
+            with pytest.raises(RuntimeError):
+                prims.matmul(a, b)
+
+    def test_reshape_infers_minus_one(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = TensorProxy(shape=(4, 6), dtype=dtypes.float32, device=devices.cpu)
+            out = clang.reshape(a, (2, -1))
+        assert out.shape == (2, 12)
+
+    def test_getitem_basic(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = TensorProxy(shape=(4, 6, 8), dtype=dtypes.float32, device=devices.cpu)
+            assert clang.getitem(a, 0).shape == (6, 8)
+            assert clang.getitem(a, (slice(1, 3),)).shape == (2, 6, 8)
+            assert clang.getitem(a, (None, Ellipsis, 0)).shape == (1, 4, 6)
+
+    def test_number_constant_folding(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            n = NumberProxy(3, python_type=int)
+            m = n + 4
+        assert m == 7
+
+
+class TestRoundTrip:
+    def test_trace_callable_executes(self):
+        import thunder_tpu.executors.jaxex  # noqa: F401
+        from thunder_tpu.executors.passes import transform_for_execution
+        from thunder_tpu.extend import get_executor
+
+        trc = dce(make_trace_add_mul())
+        ex = transform_for_execution(trc, (get_executor("jax"),))
+        fn = ex.python_callable()
+        a = np.random.randn(4, 5).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        out = fn(a, b)
+        np.testing.assert_allclose(np.asarray(out), (a + b) * (a + b), rtol=1e-5)

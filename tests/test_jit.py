@@ -1,0 +1,178 @@
+"""End-to-end jit tests: caching/guards, numerics vs numpy/torch, RNG.
+
+Modeled on the reference's thunder/tests/test_jit_general.py.
+"""
+
+import numpy as np
+import pytest
+
+import thunder_tpu as ttpu
+import thunder_tpu.clang as clang
+
+
+def test_elementwise_add_mul():
+    def foo(a, b):
+        return clang.mul(clang.add(a, b), 2.0)
+
+    jfoo = ttpu.jit(foo)
+    a = np.random.randn(4, 5).astype(np.float32)
+    b = np.random.randn(4, 5).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(jfoo(a, b)), (a + b) * 2, rtol=1e-5)
+
+
+def test_cache_hit_on_same_metadata():
+    def foo(a):
+        return clang.sin(a)
+
+    jfoo = ttpu.jit(foo)
+    a = np.random.randn(3).astype(np.float32)
+    jfoo(a)
+    jfoo(a * 2)  # same metadata, different values → hit
+    assert ttpu.cache_misses(jfoo) == 1
+    assert ttpu.cache_hits(jfoo) == 1
+
+
+def test_cache_miss_on_new_shape():
+    def foo(a):
+        return clang.sin(a)
+
+    jfoo = ttpu.jit(foo)
+    jfoo(np.random.randn(3).astype(np.float32))
+    jfoo(np.random.randn(4).astype(np.float32))
+    assert ttpu.cache_misses(jfoo) == 2
+    # Original shape still cached
+    jfoo(np.random.randn(3).astype(np.float32))
+    assert ttpu.cache_hits(jfoo) == 1
+
+
+def test_cache_miss_on_new_dtype():
+    def foo(a):
+        return clang.add(a, a)
+
+    jfoo = ttpu.jit(foo)
+    jfoo(np.random.randn(3).astype(np.float32))
+    jfoo(np.random.randn(3).astype(np.float64))
+    assert ttpu.cache_misses(jfoo) == 2
+
+
+def test_number_guard():
+    def foo(a, n):
+        return clang.mul(a, n)
+
+    jfoo = ttpu.jit(foo)
+    a = np.random.randn(3).astype(np.float32)
+    out2 = jfoo(a, 2.0)
+    out3 = jfoo(a, 3.0)  # number value changed → recompile (CONSTANT_VALUES)
+    np.testing.assert_allclose(np.asarray(out2), a * 2, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out3), a * 3, rtol=1e-5)
+    assert ttpu.cache_misses(jfoo) == 2
+
+
+def test_nested_container_inputs():
+    def foo(pair, cfg):
+        a, b = pair
+        return clang.add(clang.mul(a, cfg["scale"]), b)
+
+    jfoo = ttpu.jit(foo)
+    a = np.random.randn(2, 3).astype(np.float32)
+    b = np.random.randn(2, 3).astype(np.float32)
+    out = jfoo((a, b), {"scale": 3.0})
+    np.testing.assert_allclose(np.asarray(out), a * 3 + b, rtol=1e-5)
+
+
+def test_python_control_flow_specializes():
+    def foo(a, flag):
+        if flag:
+            return clang.sin(a)
+        return clang.cos(a)
+
+    jfoo = ttpu.jit(foo)
+    a = np.random.randn(3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(jfoo(a, True)), np.sin(a), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(jfoo(a, False)), np.cos(a), rtol=1e-5)
+    assert ttpu.cache_misses(jfoo) == 2
+
+
+def test_torch_tensor_inputs_round_trip():
+    torch = pytest.importorskip("torch")
+
+    def foo(a, b):
+        return clang.add(a, b)
+
+    jfoo = ttpu.jit(foo)
+    a = torch.randn(4, 4)
+    b = torch.randn(4, 4)
+    out = jfoo(a, b)
+    assert isinstance(out, torch.Tensor)
+    torch.testing.assert_close(out, a + b, rtol=1e-5, atol=1e-5)
+
+
+def test_bfloat16_round_trip():
+    torch = pytest.importorskip("torch")
+
+    def foo(a):
+        return clang.mul(a, 2.0)
+
+    jfoo = ttpu.jit(foo)
+    a = torch.randn(8, 8, dtype=torch.bfloat16)
+    out = jfoo(a)
+    assert out.dtype == torch.bfloat16
+    torch.testing.assert_close(out, a * 2)
+
+
+def test_rng_functionalization():
+    from thunder_tpu.core import devices as tdevices
+
+    def foo(a):
+        noise = clang.uniform((3, 3), 0.0, 1.0, device=tdevices.Device("cpu"), dtype=None)
+        return clang.add(a, noise)
+
+    jfoo = ttpu.jit(foo)
+    a = np.zeros((3, 3), dtype=np.float32)
+    out1 = np.asarray(jfoo(a))
+    out2 = np.asarray(jfoo(a))
+    assert (out1 >= 0).all() and (out1 <= 1).all()
+    assert not np.allclose(out1, out2)  # fresh key per call
+    # trace gained an rng_key input
+    src = ttpu.last_traces(jfoo)[-1].python()
+    assert "rng_key" in src
+
+
+def test_reductions_match_numpy():
+    def foo(a):
+        return (
+            clang.sum(a, (1,)),
+            clang.mean(a, (0,)),
+            clang.amax(a, (0, 1)),
+            clang.var(a, (1,), correction=1),
+        )
+
+    jfoo = ttpu.jit(foo)
+    a = np.random.randn(4, 6).astype(np.float32)
+    s, m, mx, v = jfoo(a)
+    np.testing.assert_allclose(np.asarray(s), a.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m), a.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(mx), a.max(), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(v), a.var(1, ddof=1), rtol=1e-4)
+
+
+def test_matmul_linear():
+    def foo(x, w, b):
+        return clang.linear(x, w, b)
+
+    jfoo = ttpu.jit(foo)
+    x = np.random.randn(8, 16).astype(np.float32)
+    w = np.random.randn(32, 16).astype(np.float32)
+    b = np.random.randn(32).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(jfoo(x, w, b)), x @ w.T + b, rtol=1e-4, atol=1e-4)
+
+
+def test_no_caching_option():
+    def foo(a):
+        return clang.neg(a)
+
+    jfoo = ttpu.jit(foo, cache="no caching")
+    a = np.random.randn(3).astype(np.float32)
+    jfoo(a)
+    jfoo(a)
+    assert ttpu.cache_misses(jfoo) == 2

@@ -1,0 +1,29 @@
+"""thunder_tpu: a TPU-native source-to-source JIT compiler for PyTorch programs.
+
+Built from scratch with the capabilities of Lightning Thunder
+(reference: carmocca/lightning-thunder): programs are acquired into a
+readable trace IR over a reduced primitive set, transformed (autodiff,
+autocast, DCE/CSE, rematerialization, distributed rewrites), and executed by
+priority-ordered pluggable executors — here JAX/XLA and Pallas kernels over
+TPU, with `jax.lax` collectives on an ICI/DCN device mesh for distribution.
+
+Public surface mirrors the reference's thunder/__init__.py: `jit`,
+`last_traces`, `compile_data`, `grad`, ThunderModule, etc.
+"""
+
+__version__ = "0.1.0"
+
+from thunder_tpu.core import dtypes, devices  # noqa: F401
+from thunder_tpu.api import (  # noqa: F401
+    jit,
+    seed,
+    compile_data,
+    compile_stats,
+    last_traces,
+    last_prologue_traces,
+    last_backward_traces,
+    last_compile_options,
+    cache_hits,
+    cache_misses,
+)
+

@@ -1,0 +1,115 @@
+"""CompileData / CompileStats / CacheEntry.
+
+Reference parity: thunder/common.py (`CompileData:138`, `CompileStats:54`,
+`CacheEntry` in thunder/__init__.py:281) and thunder/core/options.py
+(CACHE_OPTIONS, SHARP_EDGES_OPTIONS).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+
+class CACHE_OPTIONS(enum.Enum):
+    NO_CACHING = enum.auto()
+    CONSTANT_VALUES = enum.auto()
+    SAME_INPUT = enum.auto()
+    SYMBOLIC_VALUES = enum.auto()  # reserved, as in the reference
+
+
+_string_to_cache_option = {
+    "no caching": CACHE_OPTIONS.NO_CACHING,
+    "constant values": CACHE_OPTIONS.CONSTANT_VALUES,
+    "same input": CACHE_OPTIONS.SAME_INPUT,
+    "symbolic values": CACHE_OPTIONS.SYMBOLIC_VALUES,
+}
+
+
+def resolve_cache_option(x: Any) -> CACHE_OPTIONS:
+    if isinstance(x, CACHE_OPTIONS):
+        return x
+    if isinstance(x, str):
+        opt = _string_to_cache_option.get(x.lower())
+        if opt is not None:
+            return opt
+    raise ValueError(f"Unknown cache option {x!r}")
+
+
+class SHARP_EDGES_OPTIONS(enum.Enum):
+    ALLOW = enum.auto()
+    WARN = enum.auto()
+    ERROR = enum.auto()
+
+
+@dataclass
+class CompileData:
+    """Options resolved at jit() time (reference: thunder/common.py:138)."""
+
+    fn: Callable
+    executors_list: tuple = ()
+    cache_option: CACHE_OPTIONS = CACHE_OPTIONS.CONSTANT_VALUES
+    sharp_edges: SHARP_EDGES_OPTIONS = SHARP_EDGES_OPTIONS.ALLOW
+    disable_jit_staging: bool = False
+    is_module: bool = False
+    compile_options: dict = field(default_factory=dict)
+    # Distributed state (set by thunder_tpu.parallel transforms)
+    use_ddp: bool = False
+    use_fsdp: bool = False
+    process_group: Any = None
+    _used_options: dict = field(default_factory=dict)
+
+    def get_compile_option(self, name: str, doc: str) -> Any:
+        self._used_options[name] = doc
+        return self.compile_options.get(name)
+
+    def last_compile_options(self) -> dict:
+        return dict(self._used_options)
+
+
+@dataclass
+class CacheEntry:
+    """One compiled specialization (reference: thunder/__init__.py:281)."""
+
+    prologue_fn: Callable
+    computation_fn: Callable
+    epilogue_fn: Optional[Callable]
+    backward_fn: Optional[Callable]
+    prologue_traces: list
+    computation_traces: list
+    backward_traces: list
+    return_none_instead_of_grads: bool = False
+    torch_facing: bool = False
+    needs_rng: bool = False
+
+
+class CompileStats:
+    """Timers, caches, trace history (reference: thunder/common.py:54)."""
+
+    def __init__(self):
+        self.cache_entries: list[CacheEntry] = []
+        self.cache_hits: int = 0
+        self.cache_misses: int = 0
+        self.calls: int = 0
+        self.last_traces: list = []
+        self.last_prologue_traces: list = []
+        self.last_backward_traces: list = []
+        # nanosecond timers
+        self.last_trace_host_start: int = 0
+        self.last_trace_host_stop: int = 0
+        self.last_trace_cache_start: int = 0
+        self.last_trace_cache_stop: int = 0
+        self.last_trace_tracing_start: int = 0
+        self.last_trace_tracing_stop: int = 0
+        self.last_trace_host_execution_start: int = 0
+        self.last_trace_host_execution_stop: int = 0
+
+    @property
+    def last_compile_time_ms(self) -> float:
+        return (self.last_trace_tracing_stop - self.last_trace_tracing_start) / 1e6
+
+
+def timer_ns() -> int:
+    return time.perf_counter_ns()

@@ -1,0 +1,627 @@
+"""Proxies: the abstract values that flow through traces.
+
+Reference parity: thunder/core/proxies.py (`Proxy:91`, `NumberProxy:567`,
+`TensorProxy:1147`, `FutureTensorProxy:1064`, `Variable`, `variableify:47`,
+`DistParallelType` a.k.a. `DDPType:995`).
+
+TPU-first differences:
+- ``TensorProxy`` carries an optional ``sharding`` — a named-axis partition
+  spec (tuple of mesh-axis names or None per dim) — so distributed transforms
+  annotate placement directly in the IR and lowering emits GSPMD shardings
+  rather than explicit NCCL calls.
+- Devices are CPU/TPU; multi-chip placement is a property of the sharding,
+  not of the device index.
+"""
+
+from __future__ import annotations
+
+from numbers import Number
+from typing import Any, Callable, Optional, Sequence
+
+from thunder_tpu.core import baseutils, devices, dtypes
+from thunder_tpu.core.baseutils import ProxyInterface, check
+from thunder_tpu.core.langctxs import resolve_method
+
+
+import enum
+
+
+class DistParallelType(enum.Enum):
+    """How a parameter is laid out across the data-parallel mesh axis.
+
+    Reference parity: thunder/core/proxies.py `DDPType:995` (NONE / REPLICATED
+    / FULLY_SHARDED), extended with COLUMN_WISE/ROW_WISE used by tensor
+    parallelism (absent from the reference; first-class here).
+    """
+
+    NONE = enum.auto()
+    REPLICATED = enum.auto()
+    FULLY_SHARDED = enum.auto()
+    COLUMN_WISE = enum.auto()
+    ROW_WISE = enum.auto()
+
+
+def _get_tracectx():
+    from thunder_tpu.core.trace import get_tracectx
+
+    return get_tracectx()
+
+
+class Proxy(ProxyInterface):
+    """Base class for all abstract trace values."""
+
+    _counter_prefix = "p"
+
+    def __init__(self, name: Optional[str] = None, *, prefix: Optional[str] = None):
+        trace = _get_tracectx()
+        if name is None:
+            prefix = prefix if prefix is not None else self._counter_prefix
+            if trace is not None:
+                name = trace.make_name(prefix=prefix)
+            else:
+                name = f"{prefix}?"
+        else:
+            if trace is not None:
+                trace.add_name(name)
+        self._name = name
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def replace_name(self, name: str) -> "Proxy":
+        """Return a copy of this proxy with a different name."""
+        return self.__class__(name=name)
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self._name}>"
+
+    def type_string(self) -> str:
+        return "Any"
+
+    # Proxies are hashable by identity; Variable wraps them for by-name keys.
+    def __hash__(self) -> int:
+        return id(self)
+
+    def __eq__(self, other: Any) -> Any:
+        return self is other
+
+
+class Variable:
+    """Hashable by-name wrapper over a proxy (reference: proxies.py:27)."""
+
+    __slots__ = ("proxy",)
+
+    def __init__(self, proxy: Proxy):
+        self.proxy = proxy
+
+    def __hash__(self) -> int:
+        return hash(self.proxy._name)
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Variable) and self.proxy._name == other.proxy._name
+
+    def __repr__(self) -> str:
+        return f"Variable({self.proxy._name})"
+
+
+def variableify(x: Any) -> Any:
+    return Variable(x) if isinstance(x, Proxy) else x
+
+
+def unvariableify(x: Any) -> Any:
+    return x.proxy if isinstance(x, Variable) else x
+
+
+class AnyProxy(Proxy):
+    """Wraps an opaque Python value observed during tracing."""
+
+    _counter_prefix = "any"
+
+    def __init__(self, value: Any = None, name: Optional[str] = None, prefix: Optional[str] = None):
+        super().__init__(name, prefix=prefix)
+        self.value = value
+
+    def replace_name(self, name: str) -> "AnyProxy":
+        return AnyProxy(self.value, name=name)
+
+
+class StringProxy(Proxy):
+    _counter_prefix = "s"
+
+    def __init__(self, value: str, name: Optional[str] = None):
+        super().__init__(name)
+        self.value = value
+
+    def replace_name(self, name: str) -> "StringProxy":
+        return StringProxy(self.value, name=name)
+
+
+class CollectionProxy(Proxy):
+    _counter_prefix = "C"
+
+    def __init__(self, coll: Any, name: Optional[str] = None):
+        super().__init__(name)
+        self.coll = coll
+
+    def replace_name(self, name: str) -> "CollectionProxy":
+        return CollectionProxy(self.coll, name=name)
+
+
+class NumberProxy(Proxy):
+    """A Python number flowing through the trace.
+
+    ``value`` is the concrete value observed while tracing (used for constant
+    folding and CONSTANT_VALUES caching); ``python_type`` is bool/int/float/
+    complex. Static by default — the cache guards on the value — matching the
+    reference's default CONSTANT_VALUES cache mode.
+    """
+
+    _counter_prefix = "n"
+
+    def __init__(
+        self,
+        value: Optional[Number] = None,
+        name: Optional[str] = None,
+        python_type: Optional[type] = None,
+        prefix: Optional[str] = None,
+    ):
+        super().__init__(name, prefix=prefix or self._prefix_for(python_type))
+        self.value = value
+        self.python_type = python_type if python_type is not None else type(value)
+
+    @staticmethod
+    def _prefix_for(python_type: Optional[type]) -> str:
+        return {bool: "b", int: "i", float: "f", complex: "c"}.get(python_type, "n")
+
+    def replace_name(self, name: str) -> "NumberProxy":
+        return NumberProxy(self.value, name=name, python_type=self.python_type)
+
+    def type_string(self) -> str:
+        return self.python_type.__name__
+
+    @property
+    def dtype(self) -> dtypes.dtype:
+        return dtypes.numbertype_to_dtype(self.python_type)
+
+    def known_value(self) -> bool:
+        return self.value is not None
+
+    def __index__(self) -> int:
+        check(self.value is not None, "Cannot use an unknown NumberProxy as an index")
+        return int(self.value)
+
+    def __bool__(self) -> bool:
+        check(
+            self.value is not None,
+            "Cannot branch on an unknown NumberProxy (data-dependent control flow)",
+        )
+        return bool(self.value)
+
+    def __int__(self) -> int:
+        check(self.value is not None, "Cannot concretize an unknown NumberProxy")
+        return int(self.value)
+
+    def __float__(self) -> float:
+        check(self.value is not None, "Cannot concretize an unknown NumberProxy")
+        return float(self.value)
+
+    # Arithmetic dunders route through the active language so the ops are
+    # recorded when symbolic-values mode arrives; with known values they
+    # constant-fold at trace time.
+    def _number_binop(self, other, op: Callable, name: str):
+        ovalue = other.value if isinstance(other, NumberProxy) else other
+        if self.value is not None and ovalue is not None:
+            return op(self.value, ovalue)
+        method = resolve_method(name, self, other)
+        if method is not None:
+            return method(self, other)
+        raise RuntimeError(f"Cannot compute {name} on unknown numbers without a language method")
+
+    def __add__(self, other):
+        return self._number_binop(other, lambda a, b: a + b, "add")
+
+    def __radd__(self, other):
+        return self._number_binop(other, lambda a, b: b + a, "add")
+
+    def __sub__(self, other):
+        return self._number_binop(other, lambda a, b: a - b, "sub")
+
+    def __rsub__(self, other):
+        return self._number_binop(other, lambda a, b: b - a, "sub")
+
+    def __mul__(self, other):
+        return self._number_binop(other, lambda a, b: a * b, "mul")
+
+    def __rmul__(self, other):
+        return self._number_binop(other, lambda a, b: b * a, "mul")
+
+    def __truediv__(self, other):
+        return self._number_binop(other, lambda a, b: a / b, "true_divide")
+
+    def __rtruediv__(self, other):
+        return self._number_binop(other, lambda a, b: b / a, "true_divide")
+
+    def __floordiv__(self, other):
+        return self._number_binop(other, lambda a, b: a // b, "floor_divide")
+
+    def __mod__(self, other):
+        return self._number_binop(other, lambda a, b: a % b, "remainder")
+
+    def __pow__(self, other):
+        return self._number_binop(other, lambda a, b: a**b, "pow")
+
+    def __neg__(self):
+        if self.value is not None:
+            return -self.value
+        return resolve_method("neg", self)(self)
+
+    def __eq__(self, other):
+        ovalue = other.value if isinstance(other, NumberProxy) else other
+        if self.value is not None and (not isinstance(other, Proxy) or ovalue is not None):
+            return self.value == ovalue
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return id(self)
+
+    def __lt__(self, other):
+        return self._number_binop(other, lambda a, b: a < b, "lt")
+
+    def __le__(self, other):
+        return self._number_binop(other, lambda a, b: a <= b, "le")
+
+    def __gt__(self, other):
+        return self._number_binop(other, lambda a, b: a > b, "gt")
+
+    def __ge__(self, other):
+        return self._number_binop(other, lambda a, b: a >= b, "ge")
+
+
+class IntegerProxy(NumberProxy):
+    def __init__(self, value=None, name=None):
+        super().__init__(value, name=name, python_type=int)
+
+
+class FloatProxy(NumberProxy):
+    def __init__(self, value=None, name=None):
+        super().__init__(value, name=name, python_type=float)
+
+
+class ComplexProxy(NumberProxy):
+    def __init__(self, value=None, name=None):
+        super().__init__(value, name=name, python_type=complex)
+
+
+def pyval(x: Any) -> Any:
+    """Concrete Python value of a (number/string) proxy or passthrough."""
+    if isinstance(x, (NumberProxy, StringProxy, AnyProxy)):
+        return x.value
+    return x
+
+
+def pytype(x: Any) -> type:
+    if isinstance(x, NumberProxy):
+        return x.python_type
+    return type(x)
+
+
+ShapeLike = Sequence[int]
+
+
+class TensorProxy(Proxy):
+    """The abstract tensor: shape, dtype, device, requires_grad, distributed
+    layout, and (TPU-first) an optional named-axis sharding spec.
+
+    Reference parity: thunder/core/proxies.py `TensorProxy:1147`.
+    """
+
+    _counter_prefix = "t"
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        shape: Optional[ShapeLike] = None,
+        device: Optional[devices.Device] = None,
+        dtype: Optional[dtypes.dtype] = None,
+        requires_grad: bool = False,
+        dist_parallel_type: DistParallelType = DistParallelType.NONE,
+        sharding: Optional[tuple] = None,
+        like: Optional["TensorProxy"] = None,
+        prefix: Optional[str] = None,
+    ):
+        super().__init__(name, prefix=prefix)
+        if like is not None:
+            shape = shape if shape is not None else like.shape
+            device = device if device is not None else like.device
+            dtype = dtype if dtype is not None else like.dtype
+            requires_grad = like.requires_grad if requires_grad is False else requires_grad
+            if sharding is None:
+                sharding = like.sharding
+        check(shape is not None, "TensorProxy requires a shape")
+        self._shape = tuple(int(s) if isinstance(s, Number) else s for s in shape)
+        self._device = devices.to_device(device) if device is not None else devices.cpu
+        self._dtype = dtypes.to_dtype(dtype, true_dtype=True) if dtype is not None else dtypes.float32
+        self._requires_grad = requires_grad and dtypes.is_inexact_dtype(self._dtype)
+        self.dist_parallel_type = dist_parallel_type
+        self.sharding = tuple(sharding) if sharding is not None else None
+        # The unsharded ("logical") shape when this proxy is a dim-0 shard of
+        # a distributed parameter (reference: proxies.py thunder_fsdp_padding_size etc.)
+        self.unsharded_shape: Optional[tuple] = None
+
+    # -- metadata ------------------------------------------------------------
+
+    @property
+    def shape(self) -> tuple:
+        return self._shape
+
+    @property
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    @property
+    def device(self) -> devices.Device:
+        return self._device
+
+    @property
+    def dtype(self) -> dtypes.dtype:
+        return dtypes.to_strong(self._dtype)
+
+    @property
+    def true_dtype(self) -> dtypes.dtype:
+        return self._dtype
+
+    @property
+    def requires_grad(self) -> bool:
+        return self._requires_grad
+
+    @property
+    def numel(self) -> int:
+        n = 1
+        for s in self._shape:
+            n *= int(s)
+        return n
+
+    @property
+    def size_bytes(self) -> int:
+        return self.numel * self.dtype.bytes
+
+    def replace_name(self, name: str) -> "TensorProxy":
+        return self.replace(name=name)
+
+    def replace(self, name: Optional[str] = None, **changes) -> "TensorProxy":
+        p = TensorProxy(
+            name=name,
+            shape=changes.get("shape", self._shape),
+            device=changes.get("device", self._device),
+            dtype=changes.get("dtype", self._dtype),
+            requires_grad=changes.get("requires_grad", self._requires_grad),
+            dist_parallel_type=changes.get("dist_parallel_type", self.dist_parallel_type),
+            sharding=changes.get("sharding", self.sharding),
+        )
+        p.unsharded_shape = changes.get("unsharded_shape", self.unsharded_shape)
+        return p
+
+    def type_string(self) -> str:
+        shard = "" if self.sharding is None else f" @{self.sharding}"
+        return f'"{self.device}" {self.dtype.shortname}{list(self.shape)}{shard}'
+
+    def __repr__(self) -> str:
+        return f"<TensorProxy {self._name}: {self.type_string()}>"
+
+    # -- python object protocol ---------------------------------------------
+
+    def __len__(self) -> int:
+        check(self.ndim > 0, "len() of a 0-d tensor")
+        return int(self._shape[0])
+
+    def size(self, dim: Optional[int] = None):
+        if dim is None:
+            return self.shape
+        return self.shape[dim]
+
+    def dim(self) -> int:
+        return self.ndim
+
+    def numel_(self) -> int:
+        return self.numel
+
+    def __bool__(self):
+        raise RuntimeError(
+            "Cannot branch on the value of a traced tensor (data-dependent control flow); "
+            "use lax-style control flow or mark the value static"
+        )
+
+    # -- method / operator dispatch via the active language ------------------
+
+    def _dispatch(self, name: str, *args, **kwargs):
+        method = resolve_method(name, self, *args, **kwargs)
+        if method is None:
+            raise AttributeError(f"No language method {name!r} for TensorProxy")
+        return method(self, *args, **kwargs)
+
+    def __getattr__(self, name: str):
+        # Only called when normal lookup fails: resolve tensor methods
+        # through the language context (reference: TensorProxy.__getattr__).
+        if name.startswith("_"):
+            raise AttributeError(name)
+        method = resolve_method(name)
+        if method is None:
+            raise AttributeError(f"TensorProxy has no attribute or language method {name!r}")
+        import functools
+
+        return functools.partial(method, self)
+
+    # arithmetic
+    def __add__(self, other):
+        return self._dispatch("add", other)
+
+    def __radd__(self, other):
+        return resolve_method("add", other, self)(other, self)
+
+    def __sub__(self, other):
+        return self._dispatch("sub", other)
+
+    def __rsub__(self, other):
+        return resolve_method("sub", other, self)(other, self)
+
+    def __mul__(self, other):
+        return self._dispatch("mul", other)
+
+    def __rmul__(self, other):
+        return resolve_method("mul", other, self)(other, self)
+
+    def __truediv__(self, other):
+        return self._dispatch("true_divide", other)
+
+    def __rtruediv__(self, other):
+        return resolve_method("true_divide", other, self)(other, self)
+
+    def __floordiv__(self, other):
+        return self._dispatch("floor_divide", other)
+
+    def __mod__(self, other):
+        return self._dispatch("remainder", other)
+
+    def __pow__(self, other):
+        return self._dispatch("pow", other)
+
+    def __rpow__(self, other):
+        return resolve_method("pow", other, self)(other, self)
+
+    def __matmul__(self, other):
+        return self._dispatch("matmul", other)
+
+    def __rmatmul__(self, other):
+        return resolve_method("matmul", other, self)(other, self)
+
+    def __neg__(self):
+        return self._dispatch("neg")
+
+    def __abs__(self):
+        return self._dispatch("abs")
+
+    # comparisons
+    def __eq__(self, other):
+        return self._dispatch("eq", other)
+
+    def __ne__(self, other):
+        return self._dispatch("ne", other)
+
+    def __lt__(self, other):
+        return self._dispatch("lt", other)
+
+    def __le__(self, other):
+        return self._dispatch("le", other)
+
+    def __gt__(self, other):
+        return self._dispatch("gt", other)
+
+    def __ge__(self, other):
+        return self._dispatch("ge", other)
+
+    def __hash__(self) -> int:
+        return id(self)
+
+    # logical
+    def __and__(self, other):
+        return self._dispatch("bitwise_and", other)
+
+    def __or__(self, other):
+        return self._dispatch("bitwise_or", other)
+
+    def __xor__(self, other):
+        return self._dispatch("bitwise_xor", other)
+
+    def __invert__(self):
+        return self._dispatch("bitwise_not")
+
+    # indexing
+    def __getitem__(self, key):
+        return self._dispatch("getitem", key)
+
+
+class FutureTensorProxy(TensorProxy):
+    """Result of an async collective; must be resolved by a ``wait`` prim.
+
+    Reference parity: thunder/core/proxies.py `FutureTensorProxy:1064`. On
+    TPU the executor lowers wait() to identity — XLA's latency-hiding
+    scheduler provides the async overlap — but the IR keeps the future/wait
+    structure so trace-level comm scheduling is expressible.
+    """
+
+    _counter_prefix = "fut"
+
+    def replace_name(self, name: str) -> "FutureTensorProxy":
+        p = FutureTensorProxy(
+            name=name,
+            shape=self._shape,
+            device=self._device,
+            dtype=self._dtype,
+        )
+        p.sharding = self.sharding
+        return p
+
+
+def is_proxy(x: Any) -> bool:
+    return isinstance(x, Proxy)
+
+
+def is_proxyable(x: Any) -> bool:
+    return isinstance(x, Number) or _is_concrete_tensor(x)
+
+
+def _is_concrete_tensor(x: Any) -> bool:
+    import numpy as np
+
+    if isinstance(x, np.ndarray):
+        return True
+    tname = type(x).__module__
+    return tname.startswith("jax") and hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def proxy(x: Any, *, name: Optional[str] = None) -> Any:
+    """Wrap a concrete value in the appropriate proxy (reference: proxies.py `proxy`)."""
+    if isinstance(x, Proxy):
+        return x
+    if isinstance(x, bool):
+        return NumberProxy(x, name=name, python_type=bool)
+    if isinstance(x, int):
+        return IntegerProxy(x, name=name)
+    if isinstance(x, float):
+        return FloatProxy(x, name=name)
+    if isinstance(x, complex):
+        return ComplexProxy(x, name=name)
+    if isinstance(x, str):
+        return StringProxy(x, name=name)
+    tp = tensorproxy_from_concrete(x, name=name)
+    if tp is not None:
+        return tp
+    return AnyProxy(x, name=name)
+
+
+def tensorproxy_from_concrete(x: Any, *, name: Optional[str] = None) -> Optional[TensorProxy]:
+    """Build a TensorProxy describing a concrete jax array / numpy array /
+    torch tensor (reference: proxies.py `tensorproxy:1496`)."""
+    import numpy as np
+
+    mod = type(x).__module__
+    if isinstance(x, np.ndarray):
+        return TensorProxy(name=name, shape=x.shape, device=devices.cpu, dtype=dtypes.from_jax_dtype(x.dtype))
+    if mod.startswith("jax") and hasattr(x, "dtype") and hasattr(x, "shape"):
+        try:
+            plat = list(x.devices())[0].platform if hasattr(x, "devices") else "cpu"
+        except Exception:
+            plat = "cpu"
+        dev = devices.Device("cpu" if plat == "cpu" else "tpu")
+        return TensorProxy(name=name, shape=x.shape, device=dev, dtype=dtypes.from_jax_dtype(x.dtype))
+    if mod.startswith("torch") and hasattr(x, "dtype") and hasattr(x, "layout"):
+        return TensorProxy(
+            name=name,
+            shape=tuple(x.shape),
+            device=devices.to_device(x.device),
+            dtype=dtypes.from_torch_dtype(x.dtype),
+            requires_grad=bool(getattr(x, "requires_grad", False)),
+        )
+    return None

@@ -1,0 +1,27 @@
+"""Pytree flatten/unflatten.
+
+Reference parity: thunder/core/pytree.py, which wraps the external C++
+``optree``. Here the native tree library is ``jax.tree_util`` — already the
+idiomatic, C++-backed pytree on TPU. Proxies are leaves (jax treats unknown
+types as leaves).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.tree_util as jtu
+
+tree_flatten = jtu.tree_flatten
+tree_unflatten = jtu.tree_unflatten
+tree_map = jtu.tree_map
+tree_leaves = jtu.tree_leaves
+tree_structure = jtu.tree_structure
+
+
+def tree_flatten_with_dataclass(x: Any):
+    return jtu.tree_flatten(x)
+
+
+def tree_map_only(typ, fn: Callable, tree: Any) -> Any:
+    return jtu.tree_map(lambda v: fn(v) if isinstance(v, typ) else v, tree)

@@ -1,0 +1,279 @@
+"""Symbols and bound symbols: the instructions of the trace IR.
+
+Reference parity: thunder/core/symbol.py (`Symbol:127`, `Symbol.__call__:226`,
+`BoundSymbol:280`, `from_bsym_swap_proxies:345`, `rhs:506`,
+`BoundSymbolRHS:631`).
+
+A ``Symbol`` is a traceable operation: calling it while a trace is active
+records a ``BoundSymbol``. Non-primitive symbols record their decomposition as
+nested ``subsymbols`` — the multi-level IR that lets executors claim ops at
+any level (a Pallas executor claims ``torch.scaled_dot_product_attention``
+whole; the XLA executor claims the prims it decomposes into).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Hashable, Optional, Sequence
+
+from thunder_tpu.core import baseutils, codeutils
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.proxies import Proxy, TensorProxy, Variable, variableify
+from thunder_tpu.core.pytree import tree_flatten, tree_unflatten
+
+
+# Display-module registry: maps a symbol's short module label (e.g. "prims",
+# "ltorch") to the module object bound into generated-code namespaces.
+MODULE_REGISTRY: dict[str, Any] = {}
+
+
+def register_module(label: str, module: Any) -> None:
+    MODULE_REGISTRY[label] = module
+
+
+class Symbol:
+    def __init__(
+        self,
+        name: str,
+        meta: Optional[Callable] = None,
+        *,
+        id: Optional[Any] = None,
+        is_prim: bool = False,
+        is_fusion: bool = False,
+        tags: Optional[Sequence[Any]] = None,
+        executor: Optional[Any] = None,
+        python_impl: Optional[Callable] = None,
+        python_printer: Optional[Callable] = None,
+        module: Optional[str] = None,
+        _bind_postprocess: Optional[Callable] = None,
+    ):
+        self.name = name
+        self.meta = meta
+        self.id = id if id is not None else name
+        self.is_prim = is_prim
+        self.is_fusion = is_fusion
+        self.tags = tuple(tags) if tags else ()
+        self.executor = executor
+        self.python_impl = python_impl
+        self.python_printer = python_printer
+        self.module = module  # dotted module path for display, e.g. "prims", "ttorch"
+        self._bind_postprocess = _bind_postprocess
+
+    def __repr__(self) -> str:
+        return f"[Symbol {self.qualname}]"
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.module}.{self.name}" if self.module else self.name
+
+    def __call__(self, *args, **kwargs):
+        from thunder_tpu.core.trace import get_tracectx
+
+        trace = get_tracectx()
+        if trace is None:
+            # Eager escape hatch: outside tracing, run the concrete impl.
+            if self.python_impl is not None:
+                return self.python_impl(*args, **kwargs)
+            if self.executor is not None:
+                impl = self.executor.get_impl(self.id)
+                if impl is not None:
+                    return impl(*args, **kwargs)
+            raise RuntimeError(
+                f"Symbol {self.qualname} called outside a trace and has no concrete implementation"
+            )
+
+        check(self.meta is not None, lambda: f"Symbol {self.qualname} has no meta function")
+
+        if self.is_prim:
+            result = self.meta(*args, **kwargs)
+            subsymbols = ()
+        else:
+            subsymbols = []
+            trace.push_scope(subsymbols)
+            try:
+                result = self.meta(*args, **kwargs)
+            finally:
+                trace.pop_scope()
+
+        bsym = self.bind(*args, output=result, subsymbols=tuple(subsymbols), **kwargs)
+        trace.add_bound_symbol(bsym)
+        return result
+
+    def bind(self, *args, output: Any, subsymbols: tuple = (), **kwargs) -> "BoundSymbol":
+        bsym = BoundSymbol(self, args=args, kwargs=kwargs, output=output, subsymbols=subsymbols)
+        if self._bind_postprocess is not None:
+            self._bind_postprocess(bsym)
+        return bsym
+
+
+@dataclass(frozen=True)
+class BoundSymbolRHS:
+    """Hashable (symbol, args-with-variables) key for CSE (reference: symbol.py:631)."""
+
+    sym_id: Hashable
+    args: tuple
+    kwargs: tuple
+
+    def __hash__(self) -> int:
+        try:
+            return hash((self.sym_id, self.args, self.kwargs))
+        except TypeError:
+            return hash(self.sym_id)
+
+
+class BoundSymbol(baseutils.BoundSymbolInterface):
+    def __init__(
+        self,
+        sym: Symbol,
+        args: tuple,
+        kwargs: dict,
+        output: Any,
+        subsymbols: tuple = (),
+    ):
+        self.sym = sym
+        self.args = tuple(args)
+        self.kwargs = dict(kwargs)
+        self.output = output
+        self.subsymbols = tuple(subsymbols)
+        # Objects the generated line needs bound into the exec namespace,
+        # e.g. a compiled XLA region callable (reference: _call_ctx).
+        self._call_ctx: dict[str, Any] = {}
+        self.header: str = ""
+
+    # -- flattening ----------------------------------------------------------
+
+    @property
+    def flat_args(self) -> list:
+        flat, _ = tree_flatten((self.args, self.kwargs))
+        return flat
+
+    @property
+    def flat_proxy_args(self) -> list:
+        return [a for a in self.flat_args if isinstance(a, Proxy)]
+
+    @property
+    def flat_outs(self) -> list:
+        flat, _ = tree_flatten(self.output)
+        return flat
+
+    @property
+    def flat_proxy_outs(self) -> list:
+        return [o for o in self.flat_outs if isinstance(o, Proxy)]
+
+    def _var_set(self, proxies) -> set:
+        return {variableify(p) for p in proxies}
+
+    # -- identity / CSE ------------------------------------------------------
+
+    @property
+    def rhs(self) -> BoundSymbolRHS:
+        def keyify(x):
+            if isinstance(x, Proxy):
+                return Variable(x)
+            return baseutils.make_hashable(x) if baseutils.is_collection(x) else x
+
+        flat_args, _ = tree_flatten(self.args)
+        flat_kwargs, _ = tree_flatten(tuple(sorted(self.kwargs.items())))
+        return BoundSymbolRHS(
+            self.sym.id,
+            tuple(keyify(a) for a in flat_args),
+            tuple(keyify(a) for a in flat_kwargs),
+        )
+
+    # -- rewriting -----------------------------------------------------------
+
+    def from_bsym(self, *, sym=None, args=None, kwargs=None, output=None, subsymbols=None) -> "BoundSymbol":
+        new = BoundSymbol(
+            sym if sym is not None else self.sym,
+            args=args if args is not None else self.args,
+            kwargs=kwargs if kwargs is not None else self.kwargs,
+            output=output if output is not None else self.output,
+            subsymbols=subsymbols if subsymbols is not None else self.subsymbols,
+        )
+        new._call_ctx = dict(self._call_ctx)
+        new.header = self.header
+        return new
+
+    def from_bsym_swap_proxies(self, swap_map: dict, skip_output: bool = False) -> "BoundSymbol":
+        """Replace proxies by name per ``swap_map`` (Variable → proxy).
+
+        Reference parity: symbol.py `from_bsym_swap_proxies:345` — load-bearing
+        for the fw/bw split and remat passes.
+        """
+        if not swap_map:
+            return self
+
+        def swap(x):
+            if isinstance(x, Proxy):
+                return swap_map.get(variableify(x), x)
+            return x
+
+        def swap_tree(tree):
+            flat, spec = tree_flatten(tree)
+            return tree_unflatten(spec, [swap(x) for x in flat])
+
+        new_args = swap_tree(self.args)
+        new_kwargs = swap_tree(self.kwargs)
+        new_output = self.output if skip_output else swap_tree(self.output)
+        new_subsymbols = tuple(
+            sub.from_bsym_swap_proxies(swap_map, skip_output=skip_output) for sub in self.subsymbols
+        )
+        return self.from_bsym(args=new_args, kwargs=new_kwargs, output=new_output, subsymbols=new_subsymbols)
+
+    # -- codegen -------------------------------------------------------------
+
+    def gen_call_target(self) -> tuple[str, Any]:
+        """(name, callable) to bind in the exec namespace for this line.
+
+        Claimed symbols print as ``<executor>_<name>`` bound to the executor
+        impl; unclaimed symbols print qualified by their module
+        (``prims.add``), with the module object bound in the namespace —
+        matching the reference's generated-code style.
+        """
+        if self.sym.executor is not None:
+            impl = self.sym.executor.get_impl(self.sym.id)
+            if impl is not None:
+                return f"{self.sym.executor.name}_{self.sym.name}", impl
+        if self.sym.module is not None:
+            mod = MODULE_REGISTRY.get(self.sym.module)
+            if mod is not None:
+                return f"{self.sym.module}.{self.sym.name}", (self.sym.module, mod)
+        if self.sym.python_impl is not None:
+            return self.sym.name, self.sym.python_impl
+        return self.sym.name, self.sym
+
+    def python(self, indent: int = 0, print_depth: int = 1) -> list[str]:
+        lines = []
+        pad = baseutils.indent(indent)
+        if self.header:
+            for hline in self.header.splitlines():
+                lines.append(f"{pad}# {hline}")
+
+        if self.sym.python_printer is not None:
+            printed = self.sym.python_printer(self)
+            for pline in printed if isinstance(printed, (list, tuple)) else [printed]:
+                lines.append(f"{pad}{pline}")
+            return lines
+
+        ctx_name, _ = self.gen_call_target()
+        arg_strs = [codeutils.prettyprint(a) for a in self.args]
+        kwarg_strs = [f"{k}={codeutils.prettyprint(v)}" for k, v in self.kwargs.items()]
+        call = f"{ctx_name}({', '.join(arg_strs + kwarg_strs)})"
+
+        outs = self.flat_proxy_outs
+        if self.output is None or not outs:
+            line = f"{pad}{call}"
+        else:
+            out_str = codeutils.prettyprint(self.output)
+            line = f"{pad}{out_str} = {call}"
+        lines.append(line)
+
+        if print_depth > 1 or (print_depth == -1):
+            next_depth = -1 if print_depth == -1 else print_depth - 1
+            for sub in self.subsymbols:
+                for sline in sub.python(indent + 1, next_depth):
+                    lines.append("# " + sline if False else sline)
+        return lines
+
+    def __repr__(self) -> str:
+        return "\n".join(self.python(0, print_depth=1))

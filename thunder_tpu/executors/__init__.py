@@ -1,0 +1,7 @@
+"""Executors: concrete backends for the trace IR.
+
+Reference parity: thunder/executors/ — here the backend zoo is TPU-native:
+``jaxex`` (JAX/XLA operator executor, the torchex+nvFuser seat), ``pythonex``
+(guards/prologues), and the Pallas executors (flash attention, fused
+cross-entropy — the cuDNN/Triton/Apex/TE seats).
+"""

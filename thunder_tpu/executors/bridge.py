@@ -1,0 +1,116 @@
+"""The torch/numpy ↔ JAX array boundary.
+
+Reference analogue: the reference executes on torch tensors natively; here
+the compute substrate is JAX on TPU, and torch (CPU-only in this build) is a
+*frontend* — so the boundary lives in one place. DLPack is used for
+zero-copy handoff where possible (BASELINE.json north star: "tensor proxies
+round-tripping through DLPack"), with a copying fallback for dtypes numpy
+can't express (bf16).
+"""
+
+from __future__ import annotations
+
+from numbers import Number
+from typing import Any
+
+from thunder_tpu.core import dtypes
+
+
+def is_torch_tensor(x: Any) -> bool:
+    return type(x).__module__.startswith("torch") and hasattr(x, "layout")
+
+
+def is_jax_array(x: Any) -> bool:
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
+def to_jax(x: Any) -> Any:
+    """Concrete tensor/number → jax value on the default device."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if isinstance(x, jax.Array):
+        return x
+    if isinstance(x, np.ndarray):
+        return jnp.asarray(x)
+    if is_torch_tensor(x):
+        import torch
+
+        t = x.detach().contiguous()
+        try:
+            # DLPack: zero-copy on CPU, then XLA transfers to device once.
+            arr = jnp.from_dlpack(torch.utils.dlpack.to_dlpack(t))
+        except Exception:
+            if t.dtype == torch.bfloat16:
+                arr = jnp.asarray(t.float().numpy()).astype(jnp.bfloat16)
+            else:
+                arr = jnp.asarray(t.numpy())
+        return arr
+    if isinstance(x, Number):
+        return x
+    return x
+
+
+def to_torch(x: Any) -> Any:
+    """jax array → torch tensor (CPU)."""
+    import torch
+    import numpy as np
+    import jax
+
+    if is_torch_tensor(x):
+        return x
+    if isinstance(x, jax.Array):
+        np_dtype = x.dtype
+        if str(np_dtype) == "bfloat16":
+            return torch.from_numpy(np.array(x.astype("float32"))).to(torch.bfloat16)
+        # np.array copies: device→host transfer yields a read-only buffer
+        # torch would otherwise warn about.
+        return torch.from_numpy(np.array(x))
+    return x
+
+
+def tensor_metadata(x: Any) -> tuple:
+    """(shape, device_str, framework dtype, requires_grad) of a concrete tensor."""
+    if is_torch_tensor(x):
+        return (
+            tuple(x.shape),
+            str(x.device),
+            dtypes.from_torch_dtype(x.dtype),
+            bool(x.requires_grad),
+        )
+    import jax
+
+    if isinstance(x, jax.Array):
+        try:
+            plat = list(x.devices())[0].platform
+        except Exception:
+            plat = "cpu"
+        return tuple(x.shape), ("cpu" if plat == "cpu" else "tpu"), dtypes.from_jax_dtype(x.dtype), False
+    import numpy as np
+
+    if isinstance(x, np.ndarray):
+        return tuple(x.shape), "cpu", dtypes.from_jax_dtype(x.dtype), False
+    raise ValueError(f"Not a tensor: {type(x)}")
+
+
+def framework_of(x: Any) -> str:
+    """Which array framework a concrete tensor belongs to — guarded by the
+    prologue so a cache entry compiled for numpy inputs is never reused for
+    torch inputs (the output framework follows the input framework)."""
+    if is_torch_tensor(x):
+        return "torch"
+    import jax
+
+    if isinstance(x, jax.Array):
+        return "jax"
+    return "numpy"
+
+
+def is_concrete_tensor(x: Any) -> bool:
+    import numpy as np
+    import jax
+
+    return is_torch_tensor(x) or isinstance(x, (jax.Array, np.ndarray))

@@ -1,0 +1,2 @@
+"""Trace-to-trace transforms (reference: thunder/core/transforms.py,
+transform_common.py, rematerialization.py)."""

@@ -1,0 +1,56 @@
+"""RNG functionalization: make randomness an explicit trace input.
+
+TPU-first replacement for the reference's stateful Philox RNG
+(thunder/core/prims.py `uniform_philox`, offset threading): any trace
+containing RANDOM_OP prims is rewritten so a threefry key tensor becomes a
+real trace input and each random op derives a unique subkey by folding in
+its site index. The program stays pure — XLA caches one executable and the
+host advances the seed between steps.
+"""
+
+from __future__ import annotations
+
+import time
+
+from thunder_tpu.core import dtypes, devices, prims
+from thunder_tpu.core.prims import OpTags, PrimIDs
+from thunder_tpu.core.proxies import TensorProxy, variableify
+from thunder_tpu.core.pytree import tree_flatten, tree_unflatten
+from thunder_tpu.core.trace import TraceCtx, from_trace, tracectx, wrap_in_trace_provenance
+
+RNG_TAG = "rng_functionalized"
+
+
+def functionalize_rng_ops(trace: TraceCtx) -> TraceCtx:
+    has_rng = any(OpTags.RANDOM_OP in b.sym.tags for b in trace.bound_symbols)
+    if not has_rng:
+        return trace
+
+    start = time.perf_counter_ns()
+    ntrace = from_trace(trace)
+    key = TensorProxy(name=ntrace.make_name("rng_key"), shape=(2,), dtype=dtypes.uint32, device=devices.Device())
+    swap_map = {}
+    salt = 0
+
+    with tracectx(ntrace):
+        for bsym in trace.bound_symbols:
+            bsym = bsym.from_bsym_swap_proxies(swap_map, skip_output=True)
+            if OpTags.RANDOM_OP not in bsym.sym.tags:
+                ntrace.bound_symbols.append(bsym)
+                continue
+            if bsym.sym.id == PrimIDs.UNIFORM:
+                shape, minval, maxval = bsym.args
+                new_out = prims.uniform_keyed(shape, minval, maxval, key, salt, **bsym.kwargs)
+            elif bsym.sym.id == PrimIDs.RANDN:
+                (shape,) = bsym.args
+                new_out = prims.randn_keyed(shape, key, salt, **bsym.kwargs)
+            else:
+                raise NotImplementedError(f"RNG prim {bsym.sym.qualname} not functionalized")
+            salt += 1
+            swap_map[variableify(bsym.output)] = new_out
+
+    ntrace.args = tuple(trace.args) + (key,)
+    flat_out, spec = tree_flatten(ntrace.output)
+    ntrace.output = tree_unflatten(spec, [swap_map.get(variableify(p), p) if hasattr(p, "name") else p for p in flat_out])
+    ntrace.tags[RNG_TAG] = True
+    return wrap_in_trace_provenance(ntrace, "Functionalize RNG", start)
