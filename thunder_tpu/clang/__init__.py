@@ -804,6 +804,16 @@ def embedding(indices, weight):
 
 
 @clangop()
+def stop_gradient(a):
+    return prims.stop_gradient(a)
+
+
+@clangop(method_name="cumsum")
+def cumsum(a, dim: int):
+    return prims.cumsum(a, utils.canonicalize_dim(a.ndim, dim))
+
+
+@clangop()
 def topk(a, k: int, dim: int = -1, largest: bool = True, sorted: bool = True):
     return prims.topk(a, int(pyval(k)), utils.canonicalize_dim(a.ndim, dim), bool(largest), bool(sorted))
 

@@ -85,6 +85,7 @@ class PrimIDs(enum.Enum):
     ITEM = enum.auto()
     COPY_ = enum.auto()
     SHALLOW_COPY = enum.auto()
+    STOP_GRADIENT = enum.auto()
     # Tensor creation
     FULL = enum.auto()
     IOTA = enum.auto()
@@ -110,6 +111,7 @@ class PrimIDs(enum.Enum):
     ARGSORT = enum.auto()
     SORT = enum.auto()
     TOPK = enum.auto()
+    CUMSUM = enum.auto()
     # Elementwise unary
     ABS = enum.auto()
     ACOS = enum.auto()
@@ -545,6 +547,13 @@ def _shallow_copy_meta(a: TensorProxy) -> TensorProxy:
 shallow_copy = make_prim(PrimIDs.SHALLOW_COPY, "shallow_copy", _shallow_copy_meta)
 
 
+def _stop_gradient_meta(a: TensorProxy) -> TensorProxy:
+    return TensorProxy(like=a, requires_grad=False)
+
+
+stop_gradient = make_prim(PrimIDs.STOP_GRADIENT, "stop_gradient", _stop_gradient_meta)
+
+
 def _copy__meta(src: TensorProxy, dst: TensorProxy) -> TensorProxy:
     utils.check_same_device(src, dst, op="copy_")
     return TensorProxy(like=dst)
@@ -802,6 +811,15 @@ def _sort_meta(a: TensorProxy, dim: int, descending: bool) -> tuple:
 
 
 sort = make_prim(PrimIDs.SORT, "sort", _sort_meta)
+
+
+def _cumsum_meta(a: TensorProxy, dim: int) -> TensorProxy:
+    canonicalize_dim(a.ndim, dim)
+    out_dtype = dtypes.int64 if dtypes.is_exact_dtype(a.dtype) else a.dtype
+    return TensorProxy(like=a, dtype=out_dtype)
+
+
+cumsum = make_prim(PrimIDs.CUMSUM, "cumsum", _cumsum_meta)
 
 
 def _topk_meta(a: TensorProxy, k: int, dim: int, largest: bool, sorted: bool) -> tuple:

@@ -56,6 +56,7 @@ _reg(PrimIDs.CONVERT_ELEMENT_TYPE, _convert_element_type)
 _reg(PrimIDs.DEVICE_PUT, lambda a, device: a)
 _reg(PrimIDs.ITEM, lambda a: a.item())
 _reg(PrimIDs.SHALLOW_COPY, lambda a: a)
+_reg(PrimIDs.STOP_GRADIENT, lax.stop_gradient)
 _reg(PrimIDs.COPY_, lambda src, dst: jnp.broadcast_to(src, dst.shape).astype(dst.dtype))
 
 
@@ -157,6 +158,15 @@ def _sort(a, dim, descending):
 
 
 _reg(PrimIDs.SORT, _sort)
+
+
+def _cumsum(a, dim):
+    if jnp.issubdtype(a.dtype, jnp.bool_) or jnp.issubdtype(a.dtype, jnp.integer):
+        return jnp.cumsum(a, axis=dim, dtype=jnp.int64)
+    return jnp.cumsum(a, axis=dim)
+
+
+_reg(PrimIDs.CUMSUM, _cumsum)
 
 
 def _topk(a, k, dim, largest, sorted):

@@ -1,0 +1,940 @@
+"""The torch-mirror language layer ("ltorch").
+
+Reference parity: thunder/torch/__init__.py (168 `@torchsymbol`s mirroring the
+`torch.*` / `torch.nn.functional.*` API, the `_torch_to_thunder_function_map`
+at `:61` consumed by frontend lookasides, and method registration via
+`torchsymbol:73`).
+
+Each op here is a :class:`~thunder_tpu.core.symbol.Symbol` whose meta function
+*decomposes* into clang ops and prims while tracing — producing the
+multi-level IR that lets high-priority executors (e.g. the Pallas
+flash-attention executor) claim composite ops whole, while the terminal
+JAX/XLA executor claims the prims they decompose into.
+
+The dtype/shape semantics mirror torch (type promotion, integer true-division
+producing floats, `keepdim`, negative dims, ...); the decompositions are
+written to be XLA-friendly — static shapes, `where` instead of data-dependent
+branches, reductions/matmuls the MXU can tile.
+"""
+
+from __future__ import annotations
+
+import math
+from numbers import Number
+from typing import Any, Callable, Optional, Sequence, Union
+
+import thunder_tpu.clang as clang
+import thunder_tpu.core.prims as prims
+from thunder_tpu.core import dtypes, devices, utils
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.langctxs import LanguageContext, Languages, register_langctx, resolve_language
+from thunder_tpu.core.proxies import NumberProxy, TensorProxy, pyval
+from thunder_tpu.core.symbol import Symbol, register_module
+from thunder_tpu.core.utils import canonicalize_dim, canonicalize_dims
+
+# -- language context ---------------------------------------------------------
+
+_torch_ctx = LanguageContext(Languages.TORCH)
+# The torch language is a superset of clang's method surface.
+_clang_ctx = resolve_language(Languages.CLANG)
+_torch_ctx._methods.update(_clang_ctx._methods)
+register_langctx(Languages.TORCH, _torch_ctx)
+
+# torch.foo / torch.Tensor.foo / F.foo → ltorch symbol. Consumed by the
+# module frontend's __torch_function__ dispatch (reference: thunder/torch
+# `_torch_to_thunder_function_map:61`).
+_torch_to_thunder_function_map: dict[Any, Callable] = {}
+
+
+def _resolve_torch_attr(path: str):
+    """'torch.nn.functional.linear' → the live torch object, or None."""
+    try:
+        import torch
+    except ImportError:
+        return None
+    obj = torch
+    for part in path.split(".")[1:]:
+        obj = getattr(obj, part, None)
+        if obj is None:
+            return None
+    return obj
+
+
+def torchsymbol(*torch_paths: str, method_name: Optional[str] = None, id: Optional[str] = None):
+    """Create an ltorch Symbol from a decomposition fn, registering it under
+    the given torch dotted paths and optionally as a tensor method
+    (reference: thunder/torch `torchsymbol:73`)."""
+
+    def decorator(fn: Callable) -> Symbol:
+        sym = Symbol(fn.__name__, meta=fn, id=id if id is not None else f"torch.{fn.__name__}", module="ltorch")
+        for path in torch_paths:
+            obj = _resolve_torch_attr(path)
+            if obj is not None:
+                _torch_to_thunder_function_map[obj] = sym
+        if method_name is not None:
+            _torch_ctx.register_method(method_name, sym)
+        return sym
+
+    return decorator
+
+
+def to_dtype(x) -> Optional[dtypes.dtype]:
+    return dtypes.to_dtype(x) if x is not None else None
+
+
+def _dim_seq(dim) -> Optional[tuple]:
+    if dim is None:
+        return None
+    if isinstance(dim, (int, NumberProxy)):
+        return (int(pyval(dim)),)
+    return tuple(int(pyval(d)) for d in dim)
+
+
+# =============================================================================
+# Tensor creation
+# =============================================================================
+
+
+@torchsymbol("torch.zeros")
+def zeros(*size, dtype=None, device=None, requires_grad: bool = False):
+    shape = size[0] if len(size) == 1 and isinstance(size[0], (tuple, list)) else size
+    return clang.full(tuple(shape), 0, device=device, dtype=to_dtype(dtype) or dtypes.float32)
+
+
+@torchsymbol("torch.ones")
+def ones(*size, dtype=None, device=None, requires_grad: bool = False):
+    shape = size[0] if len(size) == 1 and isinstance(size[0], (tuple, list)) else size
+    return clang.full(tuple(shape), 1, device=device, dtype=to_dtype(dtype) or dtypes.float32)
+
+
+@torchsymbol("torch.full")
+def full(size, fill_value, *, dtype=None, device=None, requires_grad: bool = False):
+    return clang.full(tuple(size), fill_value, device=device, dtype=to_dtype(dtype))
+
+
+@torchsymbol("torch.empty")
+def empty(*size, dtype=None, device=None, requires_grad: bool = False):
+    shape = size[0] if len(size) == 1 and isinstance(size[0], (tuple, list)) else size
+    return clang.full(tuple(shape), 0, device=device, dtype=to_dtype(dtype) or dtypes.float32)
+
+
+@torchsymbol("torch.zeros_like", method_name="new_zeros")
+def zeros_like(a, *, dtype=None, device=None, requires_grad: bool = False):
+    return clang.zeros_like(a, device=device, dtype=to_dtype(dtype))
+
+
+@torchsymbol("torch.ones_like")
+def ones_like(a, *, dtype=None, device=None, requires_grad: bool = False):
+    return clang.ones_like(a, device=device, dtype=to_dtype(dtype))
+
+
+@torchsymbol("torch.full_like")
+def full_like(a, fill_value, *, dtype=None, device=None, requires_grad: bool = False):
+    return clang.full_like(a, fill_value, device=device, dtype=to_dtype(dtype))
+
+
+@torchsymbol("torch.arange")
+def arange(start, end=None, step=1, *, dtype=None, device=None, requires_grad: bool = False):
+    return clang.arange(start, end, step, device=device, dtype=to_dtype(dtype))
+
+
+@torchsymbol("torch.rand")
+def rand(*size, dtype=None, device=None, requires_grad: bool = False, generator=None):
+    shape = size[0] if len(size) == 1 and isinstance(size[0], (tuple, list)) else size
+    return clang.uniform(tuple(shape), 0.0, 1.0, device=device, dtype=to_dtype(dtype) or dtypes.float32)
+
+
+@torchsymbol("torch.randn")
+def randn(*size, dtype=None, device=None, requires_grad: bool = False, generator=None):
+    shape = size[0] if len(size) == 1 and isinstance(size[0], (tuple, list)) else size
+    return clang.randn(tuple(shape), device=device, dtype=to_dtype(dtype) or dtypes.float32)
+
+
+@torchsymbol("torch.tensor")
+def tensor(data, *, dtype=None, device=None, requires_grad: bool = False):
+    if isinstance(data, TensorProxy):
+        return clang.to(data, device=device, dtype=to_dtype(dtype))
+    if isinstance(data, (Number, NumberProxy)) and not isinstance(data, (list, tuple)):
+        dt = to_dtype(dtype) or dtypes.to_strong(dtypes.numbertype_to_dtype(type(pyval(data))))
+        return clang.full((), data, device=device, dtype=dt)
+    return clang.tensor_from_sequence(data, device=device, dtype=to_dtype(dtype))
+
+
+# =============================================================================
+# Data movement / dtype casts
+# =============================================================================
+
+
+@torchsymbol("torch.Tensor.to", method_name="to")
+def to(a, *args, **kwargs):
+    device = kwargs.get("device")
+    dtype = kwargs.get("dtype")
+    for arg in args:
+        if isinstance(arg, str) or type(arg).__name__ == "device" or isinstance(arg, devices.Device):
+            device = arg
+        elif arg is not None:
+            dtype = arg
+    return clang.to(a, device=device, dtype=to_dtype(dtype))
+
+
+@torchsymbol("torch.Tensor.type_as", method_name="type_as")
+def type_as(a, b):
+    return clang.maybe_convert_to_dtype(a, b.dtype)
+
+
+def _make_cast(name: str, dtype: dtypes.dtype) -> Symbol:
+    def cast(a):
+        return clang.maybe_convert_to_dtype(a, dtype)
+
+    cast.__name__ = name
+    sym = Symbol(name, meta=cast, id=f"torch.Tensor.{name}", module="ltorch")
+    _torch_ctx.register_method(name, sym)
+    obj = _resolve_torch_attr(f"torch.Tensor.{name}")
+    if obj is not None:
+        _torch_to_thunder_function_map[obj] = sym
+    return sym
+
+
+float_ = _make_cast("float", dtypes.float32)
+double = _make_cast("double", dtypes.float64)
+half = _make_cast("half", dtypes.float16)
+bfloat16 = _make_cast("bfloat16", dtypes.bfloat16)
+long = _make_cast("long", dtypes.int64)
+int_ = _make_cast("int", dtypes.int32)
+bool_ = _make_cast("bool", dtypes.bool8)
+
+
+@torchsymbol("torch.Tensor.contiguous", method_name="contiguous")
+def contiguous(a, *, memory_format=None):
+    # All arrays are logically contiguous under XLA; layout is the compiler's.
+    return prims.shallow_copy(a)
+
+
+@torchsymbol("torch.clone", method_name="clone")
+def clone(a, *, memory_format=None):
+    return prims.shallow_copy(a)
+
+
+@torchsymbol("torch.Tensor.detach", method_name="detach")
+def detach(a):
+    return prims.stop_gradient(a)
+
+
+@torchsymbol("torch.Tensor.item", method_name="item")
+def item(a):
+    return prims.item(a)
+
+
+# =============================================================================
+# Shape operations
+# =============================================================================
+
+
+@torchsymbol("torch.Tensor.view", method_name="view")
+def view(a, *shape):
+    shape = shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple, list)) else shape
+    return reshape(a, shape)
+
+
+@torchsymbol("torch.reshape", method_name="reshape")
+def reshape(a, *shape):
+    shape = shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple, list)) else shape
+    shape = [int(pyval(s)) for s in shape]
+    if -1 in shape:
+        idx = shape.index(-1)
+        known = 1
+        for i, s in enumerate(shape):
+            if i != idx:
+                known *= s
+        check(known != 0 and a.numel % known == 0, lambda: f"cannot reshape {a.shape} to {shape}")
+        shape[idx] = a.numel // known
+    return clang.reshape(a, tuple(shape))
+
+
+@torchsymbol("torch.permute", method_name="permute")
+def permute(a, *dims):
+    dims = dims[0] if len(dims) == 1 and isinstance(dims[0], (tuple, list)) else dims
+    return clang.permute(a, tuple(int(pyval(d)) for d in dims))
+
+
+@torchsymbol("torch.transpose", method_name="transpose")
+def transpose(a, dim0: int, dim1: int):
+    return clang.transpose(a, int(pyval(dim0)), int(pyval(dim1)))
+
+
+@torchsymbol("torch.Tensor.t", method_name="t")
+def t(a):
+    check(a.ndim <= 2, "t() requires rank <= 2")
+    return clang.matrix_transpose(a) if a.ndim == 2 else a
+
+
+@torchsymbol("torch.movedim", method_name="movedim")
+def movedim(a, source, destination):
+    return clang.movedim(a, source, destination)
+
+
+@torchsymbol("torch.squeeze", method_name="squeeze")
+def squeeze(a, dim=None):
+    if dim is None:
+        dims = tuple(i for i, s in enumerate(a.shape) if s == 1)
+    else:
+        d = canonicalize_dim(a.ndim, int(pyval(dim)))
+        if a.shape[d] != 1:
+            return a
+        dims = (d,)
+    return clang.squeeze(a, dims)
+
+
+@torchsymbol("torch.unsqueeze", method_name="unsqueeze")
+def unsqueeze(a, dim: int):
+    return clang.unsqueeze(a, int(pyval(dim)))
+
+
+@torchsymbol("torch.flatten", method_name="flatten")
+def flatten(a, start_dim: int = 0, end_dim: int = -1):
+    return clang.flatten(a, int(pyval(start_dim)), int(pyval(end_dim)))
+
+
+@torchsymbol("torch.cat", "torch.concat")
+def cat(tensors, dim: int = 0):
+    return clang.cat(list(tensors), int(pyval(dim)))
+
+
+@torchsymbol("torch.stack")
+def stack(tensors, dim: int = 0):
+    return clang.stack(list(tensors), int(pyval(dim)))
+
+
+@torchsymbol("torch.chunk", method_name="chunk")
+def chunk(a, chunks: int, dim: int = 0):
+    return clang.chunk(a, int(pyval(chunks)), int(pyval(dim)))
+
+
+@torchsymbol("torch.split", method_name="split")
+def split(a, split_size_or_sections, dim: int = 0):
+    return clang.split(a, split_size_or_sections, int(pyval(dim)))
+
+
+@torchsymbol("torch.Tensor.expand", method_name="expand")
+def expand(a, *shape):
+    shape = shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple, list)) else shape
+    shape = list(int(pyval(s)) for s in shape)
+    offset = len(shape) - a.ndim
+    for i, s in enumerate(shape):
+        if s == -1:
+            check(i >= offset, "cannot use -1 for a new leading dim in expand")
+            shape[i] = a.shape[i - offset]
+    return clang.expand(a, tuple(shape))
+
+
+@torchsymbol("torch.Tensor.repeat", method_name="repeat")
+def repeat(a, *sizes):
+    sizes = sizes[0] if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)) else sizes
+    sizes = tuple(int(pyval(s)) for s in sizes)
+    check(len(sizes) >= a.ndim, "repeat requires at least a.ndim sizes")
+    offset = len(sizes) - a.ndim
+    r = a
+    for _ in range(offset):
+        r = clang.unsqueeze(r, 0)
+    # tile by interleaving reshape/broadcast per dim
+    for i, n in enumerate(sizes):
+        if n != 1:
+            r = clang.unsqueeze(r, i)
+            target = list(r.shape)
+            target[i] = n
+            r = clang.expand(r, tuple(target))
+            merged = list(r.shape)
+            merged[i + 1] = merged[i] * merged[i + 1]
+            del merged[i]
+            r = clang.reshape(r, tuple(merged))
+    return r
+
+
+@torchsymbol("torch.flip", method_name="flip")
+def flip(a, dims):
+    return clang.flip(a, dims)
+
+
+@torchsymbol("torch.Tensor.__getitem__", method_name="getitem")
+def getitem(a, key):
+    return clang.getitem(a, key)
+
+
+@torchsymbol("torch.index_select", method_name="index_select")
+def index_select(a, dim: int, index):
+    return clang.take(a, index, int(pyval(dim)))
+
+
+@torchsymbol("torch.gather", method_name="gather")
+def gather(a, dim: int, index):
+    return clang.gather(a, int(pyval(dim)), index)
+
+
+@torchsymbol("torch.scatter_add", method_name="scatter_add")
+def scatter_add(a, dim: int, index, src):
+    return clang.scatter_add(a, int(pyval(dim)), index, src)
+
+
+@torchsymbol("torch.take_along_dim", method_name="take_along_dim")
+def take_along_dim(a, indices, dim: int):
+    return clang.take_along_axis(a, indices, int(pyval(dim)))
+
+
+@torchsymbol("torch.index_put", method_name="index_put")
+def index_put(a, indices, values, accumulate: bool = False):
+    return clang.index_put(a, indices, values, accumulate)
+
+
+@torchsymbol("torch.tril", method_name="tril")
+def tril(a, diagonal: int = 0):
+    return clang.tril(a, int(pyval(diagonal)))
+
+
+@torchsymbol("torch.triu", method_name="triu")
+def triu(a, diagonal: int = 0):
+    return clang.triu(a, int(pyval(diagonal)))
+
+
+@torchsymbol("torch.Tensor.masked_fill", method_name="masked_fill")
+def masked_fill(a, mask, value):
+    return clang.where(mask, value, a)
+
+
+@torchsymbol("torch.where")
+def where(pred, a=None, b=None):
+    check(a is not None and b is not None, "where() requires three arguments")
+    return clang.where(pred, a, b)
+
+
+@torchsymbol("torch.topk", method_name="topk")
+def topk(a, k: int, dim: int = -1, largest: bool = True, sorted: bool = True):
+    return clang.topk(a, k, dim, largest, sorted)
+
+
+@torchsymbol("torch.sort", method_name="sort")
+def sort(a, dim: int = -1, descending: bool = False):
+    return clang.sort(a, dim, descending)
+
+
+@torchsymbol("torch.argsort", method_name="argsort")
+def argsort(a, dim: int = -1, descending: bool = False):
+    return clang.argsort(a, dim, descending)
+
+
+@torchsymbol("torch.cumsum", method_name="cumsum")
+def cumsum(a, dim: int, *, dtype=None):
+    r = clang.cumsum(a, int(pyval(dim)))
+    if dtype is not None:
+        r = clang.maybe_convert_to_dtype(r, to_dtype(dtype))
+    return r
+
+
+@torchsymbol("torch.repeat_interleave", method_name="repeat_interleave")
+def repeat_interleave(a, repeats: int, dim: Optional[int] = None):
+    check(isinstance(repeats, (int, NumberProxy)), "only int repeats supported")
+    n = int(pyval(repeats))
+    if dim is None:
+        a = flatten(a)
+        dim = 0
+    d = canonicalize_dim(a.ndim, int(pyval(dim)))
+    r = clang.unsqueeze(a, d + 1)
+    target = list(r.shape)
+    target[d + 1] = n
+    r = clang.expand(r, tuple(target))
+    merged = list(a.shape)
+    merged[d] = merged[d] * n
+    return clang.reshape(r, tuple(merged))
+
+
+# =============================================================================
+# Elementwise ops (torch.* functions; methods inherited from clang)
+# =============================================================================
+
+
+def _register_elementwise(name: str, clang_fn: Callable, torch_paths: Sequence[str], method: Optional[str] = None):
+    def meta(*args, **kwargs):
+        return clang_fn(*args, **kwargs)
+
+    meta.__name__ = name
+    sym = Symbol(name, meta=meta, id=f"torch.{name}", module="ltorch")
+    for path in torch_paths:
+        obj = _resolve_torch_attr(path)
+        if obj is not None:
+            _torch_to_thunder_function_map[obj] = sym
+    if method is not None:
+        _torch_ctx.register_method(method, sym)
+    return sym
+
+
+# unary
+abs = _register_elementwise("abs", clang.abs, ["torch.abs", "torch.Tensor.abs"])
+acos = _register_elementwise("acos", clang.acos, ["torch.acos"])
+asin = _register_elementwise("asin", clang.asin, ["torch.asin"])
+atan = _register_elementwise("atan", clang.atan, ["torch.atan"])
+ceil = _register_elementwise("ceil", clang.ceil, ["torch.ceil"])
+cos = _register_elementwise("cos", clang.cos, ["torch.cos", "torch.Tensor.cos"])
+cosh = _register_elementwise("cosh", clang.cosh, ["torch.cosh"])
+erf = _register_elementwise("erf", clang.erf, ["torch.erf"])
+exp = _register_elementwise("exp", clang.exp, ["torch.exp", "torch.Tensor.exp"])
+expm1 = _register_elementwise("expm1", clang.expm1, ["torch.expm1"])
+floor = _register_elementwise("floor", clang.floor, ["torch.floor"])
+isfinite = _register_elementwise("isfinite", clang.isfinite, ["torch.isfinite"])
+isinf = _register_elementwise("isinf", clang.isinf, ["torch.isinf"])
+isnan = _register_elementwise("isnan", clang.isnan, ["torch.isnan"])
+log = _register_elementwise("log", clang.log, ["torch.log", "torch.Tensor.log"])
+log1p = _register_elementwise("log1p", clang.log1p, ["torch.log1p"])
+log2 = _register_elementwise("log2", clang.log2, ["torch.log2"])
+neg = _register_elementwise("neg", clang.neg, ["torch.neg"])
+reciprocal = _register_elementwise("reciprocal", clang.reciprocal, ["torch.reciprocal"])
+round = _register_elementwise("round", clang.round, ["torch.round"])
+rsqrt = _register_elementwise("rsqrt", clang.rsqrt, ["torch.rsqrt"])
+sign = _register_elementwise("sign", clang.sign, ["torch.sign"])
+sin = _register_elementwise("sin", clang.sin, ["torch.sin", "torch.Tensor.sin"])
+sinh = _register_elementwise("sinh", clang.sinh, ["torch.sinh"])
+sqrt = _register_elementwise("sqrt", clang.sqrt, ["torch.sqrt", "torch.Tensor.sqrt"])
+tan = _register_elementwise("tan", clang.tan, ["torch.tan"])
+tanh = _register_elementwise("tanh", clang.tanh, ["torch.tanh", "torch.Tensor.tanh"])
+trunc = _register_elementwise("trunc", clang.trunc, ["torch.trunc"])
+logical_not = _register_elementwise("logical_not", clang.logical_not, ["torch.logical_not"])
+
+# binary
+add_sym = _register_elementwise("add", clang.add, ["torch.add", "torch.Tensor.add"])
+atan2 = _register_elementwise("atan2", clang.atan2, ["torch.atan2"])
+bitwise_and = _register_elementwise("bitwise_and", clang.bitwise_and, ["torch.bitwise_and"])
+bitwise_or = _register_elementwise("bitwise_or", clang.bitwise_or, ["torch.bitwise_or"])
+bitwise_xor = _register_elementwise("bitwise_xor", clang.bitwise_xor, ["torch.bitwise_xor"])
+div = _register_elementwise("div", clang.true_divide, ["torch.div", "torch.true_divide", "torch.Tensor.div"])
+eq = _register_elementwise("eq", clang.eq, ["torch.eq"])
+floor_divide = _register_elementwise("floor_divide", clang.floor_divide, ["torch.floor_divide"])
+fmod = _register_elementwise("fmod", clang.fmod, ["torch.fmod"])
+ge = _register_elementwise("ge", clang.ge, ["torch.ge"])
+gt = _register_elementwise("gt", clang.gt, ["torch.gt"])
+le = _register_elementwise("le", clang.le, ["torch.le"])
+lt = _register_elementwise("lt", clang.lt, ["torch.lt"])
+maximum = _register_elementwise("maximum", clang.maximum, ["torch.maximum"])
+minimum = _register_elementwise("minimum", clang.minimum, ["torch.minimum"])
+mul = _register_elementwise("mul", clang.mul, ["torch.mul", "torch.Tensor.mul"])
+ne = _register_elementwise("ne", clang.ne, ["torch.ne"])
+pow = _register_elementwise("pow", clang.pow, ["torch.pow", "torch.Tensor.pow"])
+remainder = _register_elementwise("remainder", clang.remainder, ["torch.remainder"])
+sub = _register_elementwise("sub", clang.sub, ["torch.sub", "torch.Tensor.sub"])
+clamp = _register_elementwise("clamp", clang.clamp, ["torch.clamp", "torch.Tensor.clamp"])
+
+
+@torchsymbol("torch.sigmoid", "torch.nn.functional.sigmoid", method_name="sigmoid")
+def sigmoid(a):
+    # 1 / (1 + exp(-x)) — stable via where on sign, but XLA's logistic is
+    # what this lowers to after fusion; keep the simple composition.
+    return clang.true_divide(1.0, clang.add(1.0, clang.exp(clang.neg(a))))
+
+
+@torchsymbol("torch.nn.functional.softplus")
+def softplus(a, beta: float = 1.0, threshold: float = 20.0):
+    scaled = clang.mul(a, beta)
+    soft = clang.true_divide(clang.log1p(clang.exp(scaled)), beta)
+    return clang.where(clang.gt(scaled, threshold), a, soft)
+
+
+# =============================================================================
+# Activations
+# =============================================================================
+
+
+@torchsymbol("torch.nn.functional.relu", method_name="relu")
+def relu(a, inplace: bool = False):
+    return clang.maximum(a, 0)
+
+
+@torchsymbol("torch.nn.functional.leaky_relu")
+def leaky_relu(a, negative_slope: float = 0.01, inplace: bool = False):
+    return clang.where(clang.gt(a, 0), a, clang.mul(a, negative_slope))
+
+
+@torchsymbol("torch.nn.functional.elu")
+def elu(a, alpha: float = 1.0, inplace: bool = False):
+    return clang.where(clang.gt(a, 0), a, clang.mul(alpha, clang.expm1(a)))
+
+
+@torchsymbol("torch.nn.functional.gelu")
+def gelu(a, approximate: str = "none"):
+    if approximate == "tanh":
+        inner = clang.mul(math.sqrt(2.0 / math.pi), clang.add(a, clang.mul(0.044715, clang.mul(a, clang.mul(a, a)))))
+        return clang.mul(clang.mul(0.5, a), clang.add(1.0, clang.tanh(inner)))
+    return clang.mul(clang.mul(0.5, a), clang.add(1.0, clang.erf(clang.mul(a, 1.0 / math.sqrt(2.0)))))
+
+
+@torchsymbol("torch.nn.functional.silu")
+def silu(a, inplace: bool = False):
+    return clang.mul(a, sigmoid(a))
+
+
+@torchsymbol("torch.nn.functional.mish")
+def mish(a, inplace: bool = False):
+    return clang.mul(a, clang.tanh(softplus(a)))
+
+
+@torchsymbol("torch.nn.functional.hardswish")
+def hardswish(a, inplace: bool = False):
+    return clang.mul(a, clang.true_divide(clang.clamp(clang.add(a, 3.0), 0.0, 6.0), 6.0))
+
+
+@torchsymbol("torch.softmax", "torch.nn.functional.softmax", method_name="softmax")
+def softmax(a, dim: int, dtype=None):
+    d = canonicalize_dim(a.ndim, int(pyval(dim)))
+    if dtype is not None:
+        a = clang.maybe_convert_to_dtype(a, to_dtype(dtype))
+    shifted = clang.sub(a, clang.amax(a, (d,), True))
+    e = clang.exp(shifted)
+    return clang.true_divide(e, clang.sum(e, (d,), True))
+
+
+@torchsymbol("torch.log_softmax", "torch.nn.functional.log_softmax", method_name="log_softmax")
+def log_softmax(a, dim: int, dtype=None):
+    d = canonicalize_dim(a.ndim, int(pyval(dim)))
+    if dtype is not None:
+        a = clang.maybe_convert_to_dtype(a, to_dtype(dtype))
+    shifted = clang.sub(a, clang.amax(a, (d,), True))
+    return clang.sub(shifted, clang.log(clang.sum(clang.exp(shifted), (d,), True)))
+
+
+# =============================================================================
+# Reductions
+# =============================================================================
+
+
+@torchsymbol("torch.sum", method_name="sum")
+def sum(a, dim=None, keepdim: bool = False, *, dtype=None):
+    return clang.sum(a, _dim_seq(dim), keepdim, dtype=to_dtype(dtype))
+
+
+@torchsymbol("torch.mean", method_name="mean")
+def mean(a, dim=None, keepdim: bool = False, *, dtype=None):
+    return clang.mean(a, _dim_seq(dim), keepdim, dtype=to_dtype(dtype))
+
+
+@torchsymbol("torch.prod", method_name="prod")
+def prod(a, dim=None, keepdim: bool = False, *, dtype=None):
+    r = clang.prod(a, _dim_seq(dim), keepdim)
+    if dtype is not None:
+        r = clang.maybe_convert_to_dtype(r, to_dtype(dtype))
+    return r
+
+
+@torchsymbol("torch.amax", method_name="amax")
+def amax(a, dim=None, keepdim: bool = False):
+    return clang.amax(a, _dim_seq(dim), keepdim)
+
+
+@torchsymbol("torch.amin", method_name="amin")
+def amin(a, dim=None, keepdim: bool = False):
+    return clang.amin(a, _dim_seq(dim), keepdim)
+
+
+@torchsymbol("torch.max", method_name="max")
+def max(a, dim=None, keepdim: bool = False):
+    if isinstance(dim, TensorProxy):
+        return clang.maximum(a, dim)
+    if dim is None:
+        return clang.amax(a, None, False)
+    d = canonicalize_dim(a.ndim, int(pyval(dim)))
+    return clang.amax(a, (d,), keepdim), clang.argmax(a, d, keepdim)
+
+
+@torchsymbol("torch.min", method_name="min")
+def min(a, dim=None, keepdim: bool = False):
+    if isinstance(dim, TensorProxy):
+        return clang.minimum(a, dim)
+    if dim is None:
+        return clang.amin(a, None, False)
+    d = canonicalize_dim(a.ndim, int(pyval(dim)))
+    return clang.amin(a, (d,), keepdim), clang.argmin(a, d, keepdim)
+
+
+@torchsymbol("torch.argmax", method_name="argmax")
+def argmax(a, dim=None, keepdim: bool = False):
+    return clang.argmax(a, dim if dim is None else int(pyval(dim)), keepdim)
+
+
+@torchsymbol("torch.argmin", method_name="argmin")
+def argmin(a, dim=None, keepdim: bool = False):
+    return clang.argmin(a, dim if dim is None else int(pyval(dim)), keepdim)
+
+
+@torchsymbol("torch.var", method_name="var")
+def var(a, dim=None, *, correction: Number = 1, keepdim: bool = False):
+    return clang.var(a, _dim_seq(dim), correction=correction, keepdim=keepdim)
+
+
+@torchsymbol("torch.var_mean")
+def var_mean(a, dim=None, *, correction: Number = 1, keepdim: bool = False):
+    return clang.var_mean(a, _dim_seq(dim), correction=correction, keepdim=keepdim)
+
+
+@torchsymbol("torch.std", method_name="std")
+def std(a, dim=None, *, correction: Number = 1, keepdim: bool = False):
+    return clang.std(a, _dim_seq(dim), correction=correction, keepdim=keepdim)
+
+
+@torchsymbol("torch.all", method_name="all")
+def all(a, dim=None, keepdim: bool = False):
+    return clang.all_tensor(a, _dim_seq(dim), keepdim)
+
+
+@torchsymbol("torch.any", method_name="any")
+def any(a, dim=None, keepdim: bool = False):
+    return clang.any_tensor(a, _dim_seq(dim), keepdim)
+
+
+# =============================================================================
+# Linear algebra / NN ops
+# =============================================================================
+
+
+@torchsymbol("torch.matmul", method_name="matmul")
+def matmul(a, b):
+    return clang.matmul(a, b)
+
+
+@torchsymbol("torch.bmm", method_name="bmm")
+def bmm(a, b):
+    check(a.ndim == 3 and b.ndim == 3, "bmm requires rank-3 tensors")
+    return clang.matmul(a, b)
+
+
+@torchsymbol("torch.nn.functional.linear")
+def linear(a, w, bias=None):
+    return clang.linear(a, w, bias)
+
+
+@torchsymbol("torch.outer", method_name="outer")
+def outer(a, b):
+    check(a.ndim == 1 and b.ndim == 1, "outer requires rank-1 tensors")
+    return clang.mul(clang.unsqueeze(a, 1), clang.unsqueeze(b, 0))
+
+
+@torchsymbol("torch.nn.functional.embedding")
+def embedding(indices, weight, padding_idx=None, max_norm=None, norm_type: float = 2.0,
+              scale_grad_by_freq: bool = False, sparse: bool = False):
+    check(max_norm is None, "embedding max_norm is not supported")
+    return clang.embedding(indices, weight)
+
+
+@torchsymbol("torch.nn.functional.conv1d")
+def conv1d(a, weight, bias=None, stride=1, padding=0, dilation=1, groups: int = 1):
+    return _convnd(a, weight, bias, stride, padding, dilation, groups, 1)
+
+
+@torchsymbol("torch.nn.functional.conv2d")
+def conv2d(a, weight, bias=None, stride=1, padding=0, dilation=1, groups: int = 1):
+    return _convnd(a, weight, bias, stride, padding, dilation, groups, 2)
+
+
+@torchsymbol("torch.nn.functional.conv3d")
+def conv3d(a, weight, bias=None, stride=1, padding=0, dilation=1, groups: int = 1):
+    return _convnd(a, weight, bias, stride, padding, dilation, groups, 3)
+
+
+def _convnd(a, weight, bias, stride, padding, dilation, groups, spatial):
+    def _seq(x):
+        return (x,) * spatial if isinstance(x, (int, NumberProxy)) else tuple(x)
+
+    return clang.convolution(a, weight, bias, _seq(stride), _seq(padding), _seq(dilation), groups)
+
+
+# =============================================================================
+# Normalization
+# =============================================================================
+
+
+@torchsymbol("torch.nn.functional.layer_norm")
+def layer_norm(a, normalized_shape, weight=None, bias=None, eps: float = 1e-5):
+    n = len(tuple(normalized_shape))
+    dims = tuple(range(a.ndim - n, a.ndim))
+    # Compute statistics in f32 for bf16 inputs (torch's mixed-precision
+    # layer_norm semantics; also the numerically right call on TPU).
+    compute_dtype = dtypes.float32 if a.dtype in (dtypes.bfloat16, dtypes.float16) else a.dtype
+    x = clang.maybe_convert_to_dtype(a, compute_dtype)
+    v, m = clang.var_mean(x, dims, correction=0, keepdim=True)
+    normed = clang.mul(clang.sub(x, m), clang.rsqrt(clang.add(v, eps)))
+    normed = clang.maybe_convert_to_dtype(normed, a.dtype)
+    if weight is not None:
+        normed = clang.mul(normed, weight)
+    if bias is not None:
+        normed = clang.add(normed, bias)
+    return normed
+
+
+@torchsymbol("torch.nn.functional.rms_norm")
+def rms_norm(a, normalized_shape, weight=None, eps: Optional[float] = None):
+    if eps is None:
+        eps = 1e-6
+    n = len(tuple(normalized_shape))
+    dims = tuple(range(a.ndim - n, a.ndim))
+    compute_dtype = dtypes.float32 if a.dtype in (dtypes.bfloat16, dtypes.float16) else a.dtype
+    x = clang.maybe_convert_to_dtype(a, compute_dtype)
+    ms = clang.mean(clang.mul(x, x), dims, True)
+    normed = clang.mul(x, clang.rsqrt(clang.add(ms, eps)))
+    normed = clang.maybe_convert_to_dtype(normed, a.dtype)
+    if weight is not None:
+        normed = clang.mul(normed, weight)
+    return normed
+
+
+@torchsymbol("torch.nn.functional.group_norm")
+def group_norm(a, num_groups: int, weight=None, bias=None, eps: float = 1e-5):
+    check(a.ndim >= 2, "group_norm requires rank >= 2")
+    N, C = a.shape[0], a.shape[1]
+    check(C % num_groups == 0, "channels must divide num_groups")
+    spatial = a.shape[2:]
+    x = clang.reshape(a, (N, num_groups, C // num_groups) + tuple(spatial))
+    dims = tuple(range(2, x.ndim))
+    v, m = clang.var_mean(x, dims, correction=0, keepdim=True)
+    normed = clang.mul(clang.sub(x, m), clang.rsqrt(clang.add(v, eps)))
+    normed = clang.reshape(normed, tuple(a.shape))
+    shape = (1, C) + (1,) * len(spatial)
+    if weight is not None:
+        normed = clang.mul(normed, clang.reshape(weight, shape))
+    if bias is not None:
+        normed = clang.add(normed, clang.reshape(bias, shape))
+    return normed
+
+
+# =============================================================================
+# Dropout and losses
+# =============================================================================
+
+
+@torchsymbol("torch.nn.functional.dropout")
+def dropout(a, p: float = 0.5, training: bool = True, inplace: bool = False):
+    p = float(pyval(p))
+    if not training or p == 0.0:
+        return a
+    check(0.0 <= p < 1.0, lambda: f"dropout p must be in [0, 1), got {p}")
+    mask = clang.lt(clang.uniform(a.shape, 0.0, 1.0, device=a.device, dtype=a.dtype), 1.0 - p)
+    return clang.mul(clang.where(mask, a, clang.zeros_like(a)), 1.0 / (1.0 - p))
+
+
+@torchsymbol("torch.nn.functional.cross_entropy")
+def cross_entropy(input, target, weight=None, ignore_index: int = -100, reduction: str = "mean",
+                  label_smoothing: float = 0.0):
+    """Fused-friendly cross-entropy: log_softmax + gather. Kept composite so
+    the Pallas CE executor can claim it whole (reference: the Triton/Apex
+    cross-entropy executor seats, thunder/executors/triton_crossentropy.py)."""
+    check(input.ndim == 2, "cross_entropy expects (N, C) logits (flatten upstream)")
+    check(target.ndim == 1, "cross_entropy expects (N,) integer targets")
+    check(weight is None, "cross_entropy class weights not supported yet")
+    N, C = input.shape
+    logp = log_softmax(input, 1)
+    picked = clang.squeeze(clang.take_along_axis(logp, clang.reshape(clang.maximum(target, 0), (N, 1)), 1), (1,))
+    nll = clang.neg(picked)
+    if label_smoothing > 0.0:
+        smooth = clang.neg(clang.mean(logp, (1,)))
+        nll = clang.add(clang.mul(nll, 1.0 - label_smoothing), clang.mul(smooth, label_smoothing))
+    valid = clang.ne(target, ignore_index)
+    nll = clang.where(valid, nll, clang.zeros_like(nll))
+    if reduction == "none":
+        return nll
+    total = clang.sum(nll, None)
+    if reduction == "sum":
+        return total
+    count = clang.sum(clang.maybe_convert_to_dtype(valid, nll.dtype), None)
+    return clang.true_divide(total, clang.maximum(count, 1.0))
+
+
+@torchsymbol("torch.nn.functional.nll_loss")
+def nll_loss(input, target, weight=None, ignore_index: int = -100, reduction: str = "mean"):
+    check(input.ndim == 2 and target.ndim == 1, "nll_loss expects (N, C) and (N,)")
+    check(weight is None, "nll_loss class weights not supported yet")
+    N, C = input.shape
+    picked = clang.squeeze(clang.take_along_axis(input, clang.reshape(clang.maximum(target, 0), (N, 1)), 1), (1,))
+    nll = clang.neg(picked)
+    valid = clang.ne(target, ignore_index)
+    nll = clang.where(valid, nll, clang.zeros_like(nll))
+    if reduction == "none":
+        return nll
+    total = clang.sum(nll, None)
+    if reduction == "sum":
+        return total
+    count = clang.sum(clang.maybe_convert_to_dtype(valid, nll.dtype), None)
+    return clang.true_divide(total, clang.maximum(count, 1.0))
+
+
+@torchsymbol("torch.nn.functional.mse_loss")
+def mse_loss(input, target, reduction: str = "mean"):
+    d = clang.sub(input, target)
+    sq = clang.mul(d, d)
+    if reduction == "none":
+        return sq
+    if reduction == "sum":
+        return clang.sum(sq, None)
+    return clang.mean(sq, None)
+
+
+# =============================================================================
+# Attention
+# =============================================================================
+
+
+@torchsymbol("torch.nn.functional.scaled_dot_product_attention")
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p: float = 0.0,
+                                 is_causal: bool = False, scale: Optional[float] = None,
+                                 enable_gqa: bool = False):
+    """SDPA over (..., H, S, E) — decomposes to matmul/softmax/matmul; kept
+    composite so the Pallas flash-attention executor claims it whole
+    (reference: the cudnnex/sdpaex executor seats)."""
+    check(dropout_p == 0.0, "sdpa dropout is not supported yet")
+    E = query.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(E)
+
+    if enable_gqa and key.shape[-3] != query.shape[-3]:
+        rep = query.shape[-3] // key.shape[-3]
+        key = repeat_interleave(key, rep, -3)
+        value = repeat_interleave(value, rep, -3)
+
+    # Attention scores in f32 for bf16 inputs: softmax accumulates in f32 on
+    # the VPU; the two matmuls stay bf16 on the MXU.
+    q = clang.mul(query, scale)
+    scores = clang.matmul(q, clang.transpose(key, -2, -1))
+    scores = clang.maybe_convert_to_dtype(scores, dtypes.float32)
+
+    S, L = query.shape[-2], key.shape[-2]
+    if is_causal:
+        check(attn_mask is None, "is_causal and attn_mask are mutually exclusive")
+        mask = clang.diagonal_mask(S, L, offset=L - S, upper=False, device=query.device)
+        scores = clang.where(clang.expand_to(mask, scores.shape), scores, clang.full_like(scores, -float("inf")))
+    elif attn_mask is not None:
+        if dtypes.is_boolean_dtype(attn_mask.dtype):
+            scores = clang.where(clang.expand_to(attn_mask, scores.shape), scores,
+                                 clang.full_like(scores, -float("inf")))
+        else:
+            scores = clang.add(scores, clang.maybe_convert_to_dtype(attn_mask, dtypes.float32))
+
+    probs = softmax(scores, -1)
+    probs = clang.maybe_convert_to_dtype(probs, value.dtype)
+    return clang.matmul(probs, value)
+
+
+# =============================================================================
+# Misc tensor methods
+# =============================================================================
+
+
+def _size(a, dim: Optional[int] = None):
+    if dim is None:
+        return tuple(a.shape)
+    return a.shape[canonicalize_dim(a.ndim, int(pyval(dim)))]
+
+
+_torch_ctx.register_method("size", _size)
+_torch_ctx.register_method("dim", lambda a: a.ndim)
+_torch_ctx.register_method("numel", lambda a: a.numel)
+_torch_ctx.register_method("float", lambda a: clang.maybe_convert_to_dtype(a, dtypes.float32))
+_torch_ctx.register_method("type", lambda a, dt=None: a.dtype if dt is None else clang.maybe_convert_to_dtype(a, dtypes.to_dtype(dt)))
+
+
+# Generated code prints ltorch symbols qualified as ``ltorch.<name>``.
+register_module("ltorch", __import__("sys").modules[__name__])
+
+
+def torch_function_map() -> dict:
+    return _torch_to_thunder_function_map
