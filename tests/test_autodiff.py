@@ -1,0 +1,292 @@
+"""VJP correctness vs torch autograd (reference: thunder/tests/test_grad.py —
+torch-oracle comparison; the fdm finite-difference leg is replaced by the
+torch oracle since both frameworks are available here).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+import thunder_tpu  # noqa: E402
+import thunder_tpu.torch as ttorch  # noqa: E402
+from thunder_tpu.api import trace_program  # noqa: E402
+from thunder_tpu.transforms.autodiff import forward_and_backward_from_trace  # noqa: E402
+from thunder_tpu.transforms.common import dce  # noqa: E402
+
+
+def _t(*shape, seed=0, scale=1.0):
+    rng = np.random.RandomState(seed + sum(shape) * 7)
+    return (rng.randn(*shape) * scale).astype(np.float32)
+
+
+def _check_grads(thunder_loss, torch_loss, arrays, *, rtol=1e-3, atol=1e-4, diff_mask=None):
+    """thunder_loss/torch_loss: scalar-loss functions over the same args."""
+    vg = thunder_tpu.value_and_grad(thunder_loss)
+    val, grads = vg(*[np.asarray(a) for a in arrays])
+
+    diff_mask = diff_mask or [np.issubdtype(np.asarray(a).dtype, np.floating) for a in arrays]
+    targs = []
+    for a, d in zip(arrays, diff_mask):
+        ta = torch.from_numpy(np.asarray(a))
+        if d:
+            ta.requires_grad_(True)
+        targs.append(ta)
+    tl = torch_loss(*targs)
+    tl.backward()
+
+    np.testing.assert_allclose(float(np.asarray(val)), float(tl.detach()), rtol=rtol, atol=atol)
+    float_targs = [ta for ta, d in zip(targs, diff_mask) if d]
+    assert len(grads) == len(float_targs)
+    for g, ta in zip(grads, float_targs):
+        np.testing.assert_allclose(np.asarray(g), ta.grad.numpy(), rtol=rtol, atol=atol)
+
+
+class TestElementwiseGrads:
+    @pytest.mark.parametrize(
+        "tname,torchfn",
+        [
+            ("exp", torch.exp), ("log", None), ("sqrt", None), ("rsqrt", None),
+            ("tanh", torch.tanh), ("sin", torch.sin), ("cos", torch.cos),
+            ("erf", torch.erf), ("abs", torch.abs), ("sigmoid", torch.sigmoid),
+        ],
+    )
+    def test_unary(self, tname, torchfn):
+        positive = tname in ("log", "sqrt", "rsqrt")
+        a = np.abs(_t(3, 4)) + 0.5 if positive else _t(3, 4)
+        tfn = getattr(ttorch, tname)
+        torchfn = torchfn or getattr(torch, tname)
+        _check_grads(
+            lambda x: ttorch.sum(tfn(x) * tfn(x)),
+            lambda x: (torchfn(x) * torchfn(x)).sum(),
+            [a],
+        )
+
+    def test_binary_chain(self):
+        a, b = _t(3, 4), _t(3, 4, seed=1)
+        _check_grads(
+            lambda x, y: ttorch.sum(x * y + x / (ttorch.abs(y) + 1.0) - y),
+            lambda x, y: (x * y + x / (y.abs() + 1.0) - y).sum(),
+            [a, b],
+        )
+
+    def test_pow(self):
+        a = np.abs(_t(3, 4)) + 0.5
+        _check_grads(
+            lambda x: ttorch.sum(ttorch.pow(x, 3.0)),
+            lambda x: (x ** 3.0).sum(),
+            [a],
+        )
+
+    def test_where_maximum(self):
+        a, b = _t(3, 4), _t(3, 4, seed=1)
+        _check_grads(
+            lambda x, y: ttorch.sum(ttorch.maximum(x, y) + ttorch.where(x > 0, x * 2.0, y)),
+            lambda x, y: (torch.maximum(x, y) + torch.where(x > 0, x * 2.0, y)).sum(),
+            [a, b],
+        )
+
+    def test_broadcast(self):
+        a, b = _t(3, 4), _t(4, seed=1)
+        _check_grads(
+            lambda x, y: ttorch.sum(x * y),
+            lambda x, y: (x * y).sum(),
+            [a, b],
+        )
+
+
+class TestReductionGrads:
+    def test_mean_var(self):
+        a = _t(4, 6)
+        _check_grads(
+            lambda x: ttorch.mean(x * x) + ttorch.sum(ttorch.var(x, 1)),
+            lambda x: (x * x).mean() + x.var(dim=1).sum(),
+            [a],
+        )
+
+    def test_amax(self):
+        a = _t(4, 6)
+        _check_grads(
+            lambda x: ttorch.sum(ttorch.amax(x, 1) * 2.0),
+            lambda x: (x.amax(1) * 2.0).sum(),
+            [a],
+        )
+
+    def test_softmax_logsoftmax(self):
+        a = _t(4, 6)
+        _check_grads(
+            lambda x: ttorch.sum(ttorch.softmax(x, -1) * ttorch.log_softmax(x, -1)),
+            lambda x: (torch.softmax(x, -1) * torch.log_softmax(x, -1)).sum(),
+            [a],
+        )
+
+
+class TestShapeGrads:
+    def test_reshape_transpose_cat(self):
+        a, b = _t(2, 6), _t(3, 4, seed=1)
+        _check_grads(
+            lambda x, y: ttorch.sum(ttorch.cat([ttorch.reshape(x, (3, 4)), ttorch.transpose(y, 0, 1).reshape(3, 4)], 0) ** 2.0),
+            lambda x, y: (torch.cat([x.reshape(3, 4), y.transpose(0, 1).reshape(3, 4)], 0) ** 2.0).sum(),
+            [a, b],
+        )
+
+    def test_slice_pad(self):
+        a = _t(5, 7)
+        _check_grads(
+            lambda x: ttorch.sum(x[1:4, ::2] * 3.0),
+            lambda x: (x[1:4, ::2] * 3.0).sum(),
+            [a],
+        )
+
+    def test_take_along_dim(self):
+        a = _t(4, 5)
+        idx = np.argsort(_t(4, 5, seed=3), axis=1)[:, :2].astype(np.int64)
+        _check_grads(
+            lambda x, i: ttorch.sum(ttorch.take_along_dim(x, i, 1) * 2.0),
+            lambda x, i: (torch.take_along_dim(x, i, 1) * 2.0).sum(),
+            [a, idx],
+        )
+
+    def test_index_select(self):
+        a = _t(5, 3)
+        idx = np.array([0, 2, 2, 4], dtype=np.int64)
+        _check_grads(
+            lambda x, i: ttorch.sum(ttorch.index_select(x, 0, i) ** 2.0),
+            lambda x, i: (torch.index_select(x, 0, i) ** 2.0).sum(),
+            [a, idx],
+        )
+
+    def test_cumsum(self):
+        a = _t(3, 5)
+        _check_grads(
+            lambda x: ttorch.sum(ttorch.cumsum(x, 1) ** 2.0),
+            lambda x: (x.cumsum(1) ** 2.0).sum(),
+            [a],
+        )
+
+
+class TestNNGrads:
+    def test_linear(self):
+        x, w, b = _t(4, 8), _t(6, 8, seed=1) * 0.3, _t(6, seed=2)
+        _check_grads(
+            lambda x, w, b: ttorch.sum(ttorch.linear(x, w, b) ** 2.0),
+            lambda x, w, b: (F.linear(x, w, b) ** 2.0).sum(),
+            [x, w, b],
+        )
+
+    def test_matmul_batched(self):
+        a, b = _t(2, 4, 8) * 0.3, _t(8, 3, seed=1) * 0.3
+        _check_grads(
+            lambda x, y: ttorch.sum(ttorch.matmul(x, y) ** 2.0),
+            lambda x, y: (torch.matmul(x, y) ** 2.0).sum(),
+            [a, b],
+        )
+
+    def test_embedding(self):
+        idx = np.array([[0, 3, 2], [1, 1, 0]], dtype=np.int64)
+        w = _t(5, 4, seed=1)
+        _check_grads(
+            lambda i, w: ttorch.sum(ttorch.embedding(i, w) ** 2.0),
+            lambda i, w: (F.embedding(i, w) ** 2.0).sum(),
+            [idx, w],
+        )
+
+    def test_layer_norm(self):
+        x, w, b = _t(4, 8), _t(8, seed=1), _t(8, seed=2)
+        _check_grads(
+            lambda x, w, b: ttorch.sum(ttorch.layer_norm(x, (8,), w, b) ** 2.0),
+            lambda x, w, b: (F.layer_norm(x, (8,), w, b) ** 2.0).sum(),
+            [x, w, b],
+            rtol=1e-3,
+        )
+
+    def test_rms_norm(self):
+        x, w = _t(4, 8), _t(8, seed=1)
+        _check_grads(
+            lambda x, w: ttorch.sum(ttorch.rms_norm(x, (8,), w) ** 2.0),
+            lambda x, w: (F.rms_norm(x, (8,), w) ** 2.0).sum(),
+            [x, w],
+            rtol=1e-3,
+        )
+
+    def test_gelu_silu(self):
+        x = _t(4, 8)
+        _check_grads(
+            lambda x: ttorch.sum(ttorch.gelu(x) + ttorch.silu(x)),
+            lambda x: (F.gelu(x) + F.silu(x)).sum(),
+            [x],
+        )
+
+    def test_cross_entropy(self):
+        logits = _t(6, 10)
+        target = np.array([1, 4, 9, 0, 2, 7], dtype=np.int64)
+        _check_grads(
+            lambda l, t: ttorch.cross_entropy(l, t),
+            lambda l, t: F.cross_entropy(l, t),
+            [logits, target],
+        )
+
+    def test_cross_entropy_ignore_index(self):
+        logits = _t(6, 10)
+        target = np.array([1, -100, 9, 0, -100, 7], dtype=np.int64)
+        _check_grads(
+            lambda l, t: ttorch.cross_entropy(l, t),
+            lambda l, t: F.cross_entropy(l, t),
+            [logits, target],
+        )
+
+    def test_sdpa_causal(self):
+        q, k, v = _t(2, 2, 4, 8) * 0.5, _t(2, 2, 4, 8, seed=1) * 0.5, _t(2, 2, 4, 8, seed=2) * 0.5
+        _check_grads(
+            lambda q, k, v: ttorch.sum(ttorch.scaled_dot_product_attention(q, k, v, is_causal=True) ** 2.0),
+            lambda q, k, v: (F.scaled_dot_product_attention(q, k, v, is_causal=True) ** 2.0).sum(),
+            [q, k, v],
+            rtol=1e-3, atol=1e-4,
+        )
+
+
+class TestSplitForwardBackward:
+    def test_split_matches_joint(self):
+        """fw/bw split traces compute the same grads as the joint transform."""
+
+        def loss_fn(x, w):
+            return ttorch.sum(ttorch.tanh(ttorch.linear(x, w)) ** 2.0)
+
+        x, w = _t(3, 4), _t(5, 4, seed=1)
+        plg, comp = trace_program(loss_fn, (x, w), {})
+        comp = dce(comp)
+        fw, bw = forward_and_backward_from_trace(comp)
+
+        saved_names = fw.tags["saved_for_backward"]
+        assert len(saved_names) > 0
+        # fw output structure: (primal_out, saved_tuple)
+        from thunder_tpu.executors.passes import transform_for_execution
+        from thunder_tpu.extend import resolve_executors
+
+        fw_fn = transform_for_execution(fw, resolve_executors(None)).python_callable()
+        bw_fn = transform_for_execution(bw, resolve_executors(None)).python_callable()
+        import jax.numpy as jnp
+
+        out, saved = fw_fn(jnp.asarray(x), jnp.asarray(w))
+        ct = jnp.ones_like(out)
+        grads = bw_fn(*saved, ct)
+
+        vg = thunder_tpu.value_and_grad(loss_fn)
+        val, jgrads = vg(x, w)
+        np.testing.assert_allclose(float(out), float(np.asarray(val)), rtol=1e-5)
+        for g1, g2 in zip(grads, jgrads):
+            np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5, atol=1e-6)
+
+    def test_saved_is_minimal_for_linear(self):
+        def f(x, w):
+            return ttorch.sum(ttorch.linear(x, w))
+
+        x, w = _t(3, 4), _t(5, 4, seed=1)
+        plg, comp = trace_program(f, (x, w), {})
+        fw, bw = forward_and_backward_from_trace(dce(comp))
+        # linear + sum: backward needs no saved activations beyond nothing —
+        # grad of sum is broadcast ones; grad of linear needs only x (for gw)
+        # and w (for gx), both of which are *inputs*, not activations.
+        saved = fw.tags["saved_for_backward"]
+        assert set(saved) <= {"t0", "t1"}, saved

@@ -16,6 +16,8 @@ __version__ = "0.1.0"
 from thunder_tpu.core import dtypes, devices  # noqa: F401
 from thunder_tpu.api import (  # noqa: F401
     jit,
+    grad,
+    value_and_grad,
     seed,
     compile_data,
     compile_stats,

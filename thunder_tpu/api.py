@@ -218,7 +218,10 @@ def _compile_entry(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict)
     comp_trc = dce(comp_trc)
     computation_traces.append(comp_trc)
 
-    # Grad split (stage 3) hooks in here when inputs require grad.
+    # Trace-to-trace transforms requested at jit() time (grad, autocast, ...).
+    for tt in cd.compile_options.get("_trace_transforms", ()):
+        comp_trc = tt(comp_trc)
+        computation_traces.append(comp_trc)
 
     comp_trc = functionalize_rng_ops(comp_trc)
     if comp_trc.tags.get(RNG_TAG):
@@ -388,6 +391,34 @@ def jit(
     fn_._lc_cd = cd
     fn_._lc_cs = cs
     return fn_
+
+
+# =============================================================================
+# Autodiff entry points (reference: thunder/__init__.py `grad:888`)
+# =============================================================================
+
+
+def grad(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
+    """Compile ``fn`` (a scalar-loss function) into a function returning
+    gradients w.r.t. its float tensor inputs, staged fw+bw under one XLA jit.
+
+    Grads are returned as a tuple ordered like the function's float tensor
+    leaves (pytree inputs are flattened in argument order).
+    """
+    if fn is None:
+        return functools.partial(grad, **jit_kwargs)
+    from thunder_tpu.transforms.autodiff import grad_transform
+
+    return jit(fn, _trace_transforms=(lambda trc: grad_transform(trc, return_value=False),), **jit_kwargs)
+
+
+def value_and_grad(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
+    """Like :func:`grad` but returns ``(value, grads)``."""
+    if fn is None:
+        return functools.partial(value_and_grad, **jit_kwargs)
+    from thunder_tpu.transforms.autodiff import grad_transform
+
+    return jit(fn, _trace_transforms=(lambda trc: grad_transform(trc, return_value=True),), **jit_kwargs)
 
 
 # =============================================================================

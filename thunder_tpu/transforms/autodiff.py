@@ -1,0 +1,811 @@
+"""Trace-level reverse-mode autodiff (VJP).
+
+Reference parity: thunder/core/transforms.py — per-prim grad rules
+(`augmented_forward_impls:2427` / `backward_impls:2460`), the `grad`
+transform (`:1295`), `augmented_forward_pass:3460`, `backward_pass:3491`,
+`forward_and_backward_from_trace:3815` — and the saved-for-backward
+filtering at `:3930-3963`.
+
+Design (TPU-first simplification): instead of a separate augmented-forward
+interpreter, the primal trace is flattened to prim level and the backward is
+built by a single reverse walk. Each prim's VJP rule references the primal
+trace's *existing* proxies directly (inputs and outputs of the prim), so
+
+- the **joint** grad trace is just primal-prims ++ backward-prims in one
+  trace — ideal for staging whole under one ``jax.jit`` (grad-of-jit, the
+  CUDA-graphs-style endgame the reference opts into late, as the default);
+- the **split** fw/bw traces for the torch-autograd bridge fall out by
+  cutting that program in two: saved-for-backward = exactly the primal
+  proxies the backward half references.
+
+Rules emit clang ops, so backward traces get the same broadcasting/promotion
+treatment as forward ones and remain readable Python.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from numbers import Number
+from typing import Any, Callable, Optional, Sequence
+
+import thunder_tpu.clang as clang
+import thunder_tpu.core.prims as prims
+from thunder_tpu.core import dtypes
+from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.prims import PrimIDs
+from thunder_tpu.core.proxies import NumberProxy, Proxy, TensorProxy, Variable, variableify
+from thunder_tpu.core.pytree import tree_flatten, tree_map, tree_unflatten
+from thunder_tpu.core.symbol import BoundSymbol
+from thunder_tpu.core.trace import TraceCtx, from_trace, tracectx, wrap_in_trace_provenance
+from thunder_tpu.transforms.common import dce
+
+
+# =============================================================================
+# Rule registry
+# =============================================================================
+
+# prim/symbol id → rule(bsym, *cotangents) -> sequence of grads aligned with
+# bsym.args (None for non-differentiable positions). Rules run under the
+# backward trace's context and may reference any primal proxy.
+_vjp_rules: dict[Any, Callable] = {}
+
+NONDIFF = object()  # registered marker: op treated as constant
+
+
+def register_vjp(sym_id):
+    def deco(fn):
+        _vjp_rules[sym_id] = fn
+        return fn
+
+    return deco
+
+
+def register_nondiff(*sym_ids) -> None:
+    for sid in sym_ids:
+        _vjp_rules[sid] = NONDIFF
+
+
+def has_vjp(sym_id) -> bool:
+    return sym_id in _vjp_rules
+
+
+# =============================================================================
+# Helpers
+# =============================================================================
+
+
+def _zeros_for(t: TensorProxy) -> TensorProxy:
+    # Static full() — deliberately NOT zeros_like(t), so the backward half
+    # does not hold a reference to (and thus save) the primal proxy.
+    return clang.full(t.shape, 0, device=t.device, dtype=t.dtype)
+
+
+def _unbroadcast(g, shape: tuple):
+    """Reduce a cotangent back to ``shape`` after clang-level broadcasting."""
+    if tuple(g.shape) == tuple(shape):
+        return g
+    extra = g.ndim - len(shape)
+    if extra > 0:
+        g = clang.sum(g, tuple(range(extra)))
+    keep = tuple(i for i, s in enumerate(shape) if s == 1 and g.shape[i] != 1)
+    if keep:
+        g = clang.sum(g, keep, True)
+    return g
+
+
+def _is_float_tensor(x) -> bool:
+    return isinstance(x, TensorProxy) and dtypes.is_inexact_dtype(x.dtype)
+
+
+# =============================================================================
+# Rules: data movement
+# =============================================================================
+
+
+@register_vjp(PrimIDs.CONVERT_ELEMENT_TYPE)
+def _convert_vjp(bsym, g):
+    a, _ = bsym.args
+    if not isinstance(a, TensorProxy):
+        return (None, None)
+    if not dtypes.is_inexact_dtype(a.dtype):
+        return (None, None)
+    return (clang.maybe_convert_to_dtype(g, a.dtype), None)
+
+
+@register_vjp(PrimIDs.SHALLOW_COPY)
+def _identity_vjp(bsym, g):
+    return (g,)
+
+
+@register_vjp(PrimIDs.DEVICE_PUT)
+def _device_put_vjp(bsym, g):
+    return (g, None)
+
+
+register_nondiff(
+    PrimIDs.STOP_GRADIENT,
+    PrimIDs.ITEM,
+    PrimIDs.FULL,
+    PrimIDs.IOTA,
+    PrimIDs.UNIFORM,
+    PrimIDs.RANDN,
+    PrimIDs.UNIFORM_KEYED,
+    PrimIDs.RANDN_KEYED,
+    PrimIDs.TENSOR_FROM_SEQUENCE,
+    PrimIDs.EQ,
+    PrimIDs.NE,
+    PrimIDs.GE,
+    PrimIDs.GT,
+    PrimIDs.LE,
+    PrimIDs.LT,
+    PrimIDs.ISFINITE,
+    PrimIDs.ISINF,
+    PrimIDs.ISNAN,
+    PrimIDs.SIGNBIT,
+    PrimIDs.SIGN,
+    PrimIDs.FLOOR,
+    PrimIDs.CEIL,
+    PrimIDs.ROUND,
+    PrimIDs.TRUNC,
+    PrimIDs.ARGMAX,
+    PrimIDs.ARGMIN,
+    PrimIDs.ARGSORT,
+    PrimIDs.BITWISE_AND,
+    PrimIDs.BITWISE_OR,
+    PrimIDs.BITWISE_XOR,
+    PrimIDs.BITWISE_NOT,
+    PrimIDs.BITWISE_LEFT_SHIFT,
+    PrimIDs.BITWISE_RIGHT_SHIFT,
+    PrimIDs.EMBEDDING_BACKWARD,
+)
+
+
+# =============================================================================
+# Rules: elementwise unary
+# =============================================================================
+
+
+def _unary_rule(fn):
+    def rule(bsym, g):
+        a = bsym.args[0]
+        if not _is_float_tensor(a) and not isinstance(a, TensorProxy):
+            return (None,)
+        return (fn(a, bsym.output, g),)
+
+    return rule
+
+
+_SQRT_PI_INV_2 = 2.0 / math.sqrt(math.pi)
+
+_unary_vjps = {
+    PrimIDs.NEG: lambda a, out, g: clang.neg(g),
+    PrimIDs.EXP: lambda a, out, g: clang.mul(g, out),
+    PrimIDs.EXP2: lambda a, out, g: clang.mul(g, clang.mul(out, math.log(2.0))),
+    PrimIDs.EXPM1: lambda a, out, g: clang.mul(g, clang.add(out, 1.0)),
+    PrimIDs.LOG: lambda a, out, g: clang.true_divide(g, a),
+    PrimIDs.LOG1P: lambda a, out, g: clang.true_divide(g, clang.add(a, 1.0)),
+    PrimIDs.LOG2: lambda a, out, g: clang.true_divide(g, clang.mul(a, math.log(2.0))),
+    PrimIDs.LOG10: lambda a, out, g: clang.true_divide(g, clang.mul(a, math.log(10.0))),
+    PrimIDs.SQRT: lambda a, out, g: clang.true_divide(clang.mul(g, 0.5), out),
+    PrimIDs.RSQRT: lambda a, out, g: clang.mul(clang.mul(g, -0.5), clang.mul(out, clang.mul(out, out))),
+    PrimIDs.RECIPROCAL: lambda a, out, g: clang.neg(clang.mul(g, clang.mul(out, out))),
+    PrimIDs.ABS: lambda a, out, g: clang.mul(g, clang.sign(a)),
+    PrimIDs.SIN: lambda a, out, g: clang.mul(g, clang.cos(a)),
+    PrimIDs.COS: lambda a, out, g: clang.neg(clang.mul(g, clang.sin(a))),
+    PrimIDs.TAN: lambda a, out, g: clang.mul(g, clang.add(1.0, clang.mul(out, out))),
+    PrimIDs.SINH: lambda a, out, g: clang.mul(g, clang.cosh(a)),
+    PrimIDs.COSH: lambda a, out, g: clang.mul(g, clang.sinh(a)),
+    PrimIDs.TANH: lambda a, out, g: clang.mul(g, clang.sub(1.0, clang.mul(out, out))),
+    PrimIDs.ASIN: lambda a, out, g: clang.true_divide(g, clang.sqrt(clang.sub(1.0, clang.mul(a, a)))),
+    PrimIDs.ACOS: lambda a, out, g: clang.neg(clang.true_divide(g, clang.sqrt(clang.sub(1.0, clang.mul(a, a))))),
+    PrimIDs.ATAN: lambda a, out, g: clang.true_divide(g, clang.add(1.0, clang.mul(a, a))),
+    PrimIDs.ASINH: lambda a, out, g: clang.true_divide(g, clang.sqrt(clang.add(clang.mul(a, a), 1.0))),
+    PrimIDs.ACOSH: lambda a, out, g: clang.true_divide(g, clang.sqrt(clang.sub(clang.mul(a, a), 1.0))),
+    PrimIDs.ATANH: lambda a, out, g: clang.true_divide(g, clang.sub(1.0, clang.mul(a, a))),
+    PrimIDs.ERF: lambda a, out, g: clang.mul(g, clang.mul(_SQRT_PI_INV_2, clang.exp(clang.neg(clang.mul(a, a))))),
+    PrimIDs.ERFC: lambda a, out, g: clang.neg(
+        clang.mul(g, clang.mul(_SQRT_PI_INV_2, clang.exp(clang.neg(clang.mul(a, a)))))
+    ),
+    PrimIDs.LGAMMA: lambda a, out, g: clang.mul(g, clang.digamma(a)),
+}
+
+for _pid, _fn in _unary_vjps.items():
+    _vjp_rules[_pid] = _unary_rule(_fn)
+
+
+# =============================================================================
+# Rules: elementwise binary / ternary
+# =============================================================================
+
+
+def _binary_rule(fa, fb):
+    def rule(bsym, g):
+        a, b = bsym.args
+        ga = fa(a, b, bsym.output, g) if _is_float_tensor(a) else None
+        gb = fb(a, b, bsym.output, g) if _is_float_tensor(b) else None
+        return (ga, gb)
+
+    return rule
+
+
+_binary_vjps = {
+    PrimIDs.ADD: (lambda a, b, out, g: g, lambda a, b, out, g: g),
+    PrimIDs.SUB: (lambda a, b, out, g: g, lambda a, b, out, g: clang.neg(g)),
+    PrimIDs.MUL: (lambda a, b, out, g: clang.mul(g, b), lambda a, b, out, g: clang.mul(g, a)),
+    PrimIDs.DIV: (
+        lambda a, b, out, g: clang.true_divide(g, b),
+        lambda a, b, out, g: clang.neg(clang.true_divide(clang.mul(g, a), clang.mul(b, b))),
+    ),
+    PrimIDs.POW: (
+        lambda a, b, out, g: clang.mul(g, clang.mul(b, clang.pow(a, clang.sub(b, 1.0)))),
+        # Guard log at a<=0: the d/db branch only matters for a>0 anyway.
+        lambda a, b, out, g: clang.mul(g, clang.mul(out, clang.log(clang.maximum(a, 1e-30)))),
+    ),
+    PrimIDs.MAXIMUM: (
+        lambda a, b, out, g: clang.where(clang.ge(a, b), g, 0.0),
+        lambda a, b, out, g: clang.where(clang.lt(a, b), g, 0.0),
+    ),
+    PrimIDs.MINIMUM: (
+        lambda a, b, out, g: clang.where(clang.le(a, b), g, 0.0),
+        lambda a, b, out, g: clang.where(clang.gt(a, b), g, 0.0),
+    ),
+    PrimIDs.ATAN2: (
+        lambda a, b, out, g: clang.true_divide(clang.mul(g, b), clang.add(clang.mul(a, a), clang.mul(b, b))),
+        lambda a, b, out, g: clang.neg(
+            clang.true_divide(clang.mul(g, a), clang.add(clang.mul(a, a), clang.mul(b, b)))
+        ),
+    ),
+    PrimIDs.FMOD: (
+        lambda a, b, out, g: g,
+        lambda a, b, out, g: clang.neg(clang.mul(g, clang.trunc(clang.true_divide(a, b)))),
+    ),
+    PrimIDs.REMAINDER: (
+        lambda a, b, out, g: g,
+        lambda a, b, out, g: clang.neg(clang.mul(g, clang.floor(clang.true_divide(a, b)))),
+    ),
+    PrimIDs.NEXTAFTER: (lambda a, b, out, g: g, lambda a, b, out, g: None),
+}
+
+for _pid, (_fa, _fb) in _binary_vjps.items():
+    _vjp_rules[_pid] = _binary_rule(_fa, _fb)
+
+
+@register_vjp(PrimIDs.WHERE)
+def _where_vjp(bsym, g):
+    pred, a, b = bsym.args
+    ga = clang.where(pred, g, 0.0) if _is_float_tensor(a) else None
+    gb = clang.where(pred, 0.0, g) if _is_float_tensor(b) else None
+    return (None, ga, gb)
+
+
+# =============================================================================
+# Rules: shape ops
+# =============================================================================
+
+
+@register_vjp(PrimIDs.BROADCAST_IN_DIM)
+def _broadcast_in_dim_vjp(bsym, g):
+    a, shape, bdims = bsym.args
+    if not _is_float_tensor(a):
+        return (None, None, None)
+    reduce_dims = tuple(d for d in range(len(shape)) if d not in bdims)
+    r = clang.sum(g, reduce_dims) if reduce_dims else g
+    # r now has rank a.ndim, in bdims order (ascending). Handle size-1 dims.
+    keep = tuple(i for i in range(a.ndim) if a.shape[i] == 1 and r.shape[i] != 1)
+    if keep:
+        r = clang.sum(r, keep, True)
+    return (r, None, None)
+
+
+@register_vjp(PrimIDs.RESHAPE)
+def _reshape_vjp(bsym, g):
+    a, _ = bsym.args
+    return (clang.reshape(g, tuple(a.shape)), None) if _is_float_tensor(a) else (None, None)
+
+
+@register_vjp(PrimIDs.TRANSPOSE)
+def _transpose_vjp(bsym, g):
+    a, perm = bsym.args
+    if not _is_float_tensor(a):
+        return (None, None)
+    inv = [0] * len(perm)
+    for i, p in enumerate(perm):
+        inv[p] = i
+    return (clang.permute(g, tuple(inv)), None)
+
+
+@register_vjp(PrimIDs.SQUEEZE)
+def _squeeze_vjp(bsym, g):
+    a, _ = bsym.args
+    return (clang.reshape(g, tuple(a.shape)), None) if _is_float_tensor(a) else (None, None)
+
+
+@register_vjp(PrimIDs.FLIP)
+def _flip_vjp(bsym, g):
+    a, dims = bsym.args
+    return (clang.flip(g, tuple(dims)), None) if _is_float_tensor(a) else (None, None)
+
+
+@register_vjp(PrimIDs.CAT)
+def _cat_vjp(bsym, g):
+    tensors, dim = bsym.args
+    grads = []
+    offset = 0
+    for t in tensors:
+        grads.append(
+            clang.slice_in_dim(g, offset, offset + t.shape[dim], dim=dim) if _is_float_tensor(t) else None
+        )
+        offset += t.shape[dim]
+    return (grads, None)
+
+
+@register_vjp(PrimIDs.SLICE)
+def _slice_vjp(bsym, g):
+    args = bsym.args
+    a, starts, ends = args[0], args[1], args[2]
+    strides = args[3] if len(args) > 3 and args[3] is not None else [1] * a.ndim
+    if not _is_float_tensor(a):
+        return (None,) * len(args)
+    config = []
+    for d in range(a.ndim):
+        out_len = g.shape[d]
+        covered = 0 if out_len == 0 else (out_len - 1) * strides[d] + 1
+        config.append((starts[d], a.shape[d] - starts[d] - covered, strides[d] - 1))
+    return (clang.pad(g, 0.0, config),) + (None,) * (len(args) - 1)
+
+
+@register_vjp(PrimIDs.PAD)
+def _pad_vjp(bsym, g):
+    a, _, config = bsym.args
+    if not _is_float_tensor(a):
+        return (None, None, None)
+    starts, ends, strides = [], [], []
+    for s, (lo, hi, dil) in zip(a.shape, config):
+        starts.append(lo)
+        ends.append(lo + (s - 1) * (dil + 1) + 1 if s > 0 else lo)
+        strides.append(dil + 1)
+    return (prims.slice_prim(g, starts, ends, strides), None, None)
+
+
+@register_vjp(PrimIDs.TAKE)
+def _take_vjp(bsym, g):
+    a, idx, dim = bsym.args
+    if not _is_float_tensor(a):
+        return (None, None, None)
+    if idx.ndim == 0:
+        g = clang.unsqueeze(g, dim)
+        idx_1d = clang.reshape(idx, (1,))
+    else:
+        idx_1d = idx
+    z = clang.full(tuple(a.shape), 0, device=a.device, dtype=a.dtype)
+    if dim != 0:
+        z = clang.movedim(z, dim, 0)
+        g = clang.movedim(g, dim, 0)
+    ga = clang.index_put(z, (idx_1d,), g, accumulate=True)
+    if dim != 0:
+        ga = clang.movedim(ga, 0, dim)
+    return (ga, None, None)
+
+
+def _scatter_back(a, idx, g, dim):
+    z = clang.full(tuple(a.shape), 0, device=a.device, dtype=a.dtype)
+    return prims.scatter_add(z, idx, g, dim)
+
+
+@register_vjp(PrimIDs.TAKE_ALONG_AXIS)
+def _take_along_axis_vjp(bsym, g):
+    a, idx, dim = bsym.args
+    if not _is_float_tensor(a):
+        return (None, None, None)
+    return (_scatter_back(a, idx, g, dim), None, None)
+
+
+@register_vjp(PrimIDs.GATHER)
+def _gather_vjp(bsym, g):
+    a, idx, dim = bsym.args
+    if not _is_float_tensor(a):
+        return (None, None, None)
+    return (_scatter_back(a, idx, g, dim), None, None)
+
+
+@register_vjp(PrimIDs.SCATTER_ADD)
+def _scatter_add_vjp(bsym, g):
+    a, idx, val, dim = bsym.args
+    ga = g if _is_float_tensor(a) else None
+    gv = prims.gather(g, idx, dim) if _is_float_tensor(val) else None
+    return (ga, gv, None, None)
+
+
+@register_vjp(PrimIDs.CUMSUM)
+def _cumsum_vjp(bsym, g):
+    a, dim = bsym.args
+    if not _is_float_tensor(a):
+        return (None, None)
+    return (clang.flip(prims.cumsum(clang.flip(g, (dim,)), dim), (dim,)), None)
+
+
+# =============================================================================
+# Rules: reductions
+# =============================================================================
+
+
+def _broadcast_to_input(g, a: TensorProxy, dims: tuple):
+    """Expand a reduced cotangent back over the reduced dims of ``a``."""
+    shape = list(a.shape)
+    for d in dims:
+        shape[d] = 1
+    g = clang.reshape(g, tuple(shape))
+    return clang.expand_to(g, tuple(a.shape))
+
+
+@register_vjp(PrimIDs.SUM)
+def _sum_vjp(bsym, g):
+    a, dims = bsym.args
+    if not _is_float_tensor(a):
+        return (None, None)
+    return (_broadcast_to_input(g, a, tuple(dims)), None)
+
+
+def _minmax_reduction_vjp(bsym, g):
+    a, dims = bsym.args
+    if not _is_float_tensor(a):
+        return (None, None)
+    dims = tuple(dims)
+    out_b = _broadcast_to_input(bsym.output, a, dims)
+    g_b = _broadcast_to_input(g, a, dims)
+    mask = clang.maybe_convert_to_dtype(clang.eq(a, out_b), a.dtype)
+    count = clang.sum(mask, dims, True)
+    return (clang.true_divide(clang.mul(g_b, mask), clang.expand_to(count, tuple(a.shape))), None)
+
+
+_vjp_rules[PrimIDs.AMAX] = _minmax_reduction_vjp
+_vjp_rules[PrimIDs.AMIN] = _minmax_reduction_vjp
+
+
+@register_vjp(PrimIDs.PROD)
+def _prod_vjp(bsym, g):
+    a, dims = bsym.args
+    if not _is_float_tensor(a):
+        return (None, None)
+    dims = tuple(dims)
+    out_b = _broadcast_to_input(bsym.output, a, dims)
+    g_b = _broadcast_to_input(g, a, dims)
+    return (clang.true_divide(clang.mul(g_b, out_b), a), None)
+
+
+def _var_input_grad(a, dims, correction, gv):
+    n = 1
+    for d in dims:
+        n *= a.shape[d]
+    m = clang.true_divide(clang.sum(a, dims, True), float(n))
+    centered = clang.sub(a, clang.expand_to(m, tuple(a.shape)))
+    scale = 2.0 / builtins_max(n - int(correction), 1)
+    return clang.mul(_broadcast_to_input(gv, a, dims), clang.mul(centered, scale))
+
+
+def builtins_max(a, b):
+    return a if a > b else b
+
+
+@register_vjp(PrimIDs.VAR)
+def _var_vjp(bsym, g):
+    a, dims = bsym.args
+    correction = bsym.kwargs.get("correction", 1)
+    if not _is_float_tensor(a):
+        return (None, None)
+    return (_var_input_grad(a, tuple(dims), correction, g), None)
+
+
+@register_vjp(PrimIDs.VAR_MEAN)
+def _var_mean_vjp(bsym, gv, gm):
+    a, dims = bsym.args
+    correction = bsym.kwargs.get("correction", 1)
+    if not _is_float_tensor(a):
+        return (None, None)
+    dims = tuple(dims)
+    n = 1
+    for d in dims:
+        n *= a.shape[d]
+    ga = None
+    if gv is not None:
+        ga = _var_input_grad(a, dims, correction, gv)
+    if gm is not None:
+        gmean = clang.mul(_broadcast_to_input(gm, a, dims), 1.0 / float(n))
+        ga = gmean if ga is None else clang.add(ga, gmean)
+    return (ga, None)
+
+
+# =============================================================================
+# Rules: linear algebra / NN
+# =============================================================================
+
+
+@register_vjp(PrimIDs.MATMUL)
+def _matmul_vjp(bsym, g):
+    a, b = bsym.args
+    ga = gb = None
+    if a.ndim == 1 and b.ndim == 1:
+        if _is_float_tensor(a):
+            ga = clang.mul(g, b)
+        if _is_float_tensor(b):
+            gb = clang.mul(g, a)
+        return (ga, gb)
+    # Promote vectors to matrices, compute the matrix rule, then strip.
+    a2 = clang.unsqueeze(a, 0) if a.ndim == 1 else a
+    b2 = clang.unsqueeze(b, 1) if b.ndim == 1 else b
+    g2 = g
+    if a.ndim == 1:
+        g2 = clang.unsqueeze(g2, -2)
+    if b.ndim == 1:
+        g2 = clang.unsqueeze(g2, -1)
+    if _is_float_tensor(a):
+        ga = clang.matmul(g2, clang.transpose(b2, -2, -1))
+        ga = _unbroadcast(ga, tuple(a2.shape))
+        if a.ndim == 1:
+            ga = clang.squeeze(ga, (ga.ndim - 2,))
+    if _is_float_tensor(b):
+        gb = clang.matmul(clang.transpose(a2, -2, -1), g2)
+        gb = _unbroadcast(gb, tuple(b2.shape))
+        if b.ndim == 1:
+            gb = clang.squeeze(gb, (gb.ndim - 1,))
+    return (ga, gb)
+
+
+@register_vjp(PrimIDs.LINEAR)
+def _linear_vjp(bsym, g):
+    a, w, bias = bsym.args
+    ga = gw = gbias = None
+    out_features, in_features = w.shape
+    if _is_float_tensor(a):
+        ga = clang.matmul(g, w)  # (..., out) @ (out, in) -> (..., in)
+    if _is_float_tensor(w):
+        batch = 1
+        for s in a.shape[:-1]:
+            batch *= s
+        a2 = clang.reshape(a, (batch, in_features))
+        g2 = clang.reshape(g, (batch, out_features))
+        gw = clang.matmul(clang.matrix_transpose(g2), a2)
+    if bias is not None and _is_float_tensor(bias):
+        gbias = clang.sum(g, tuple(range(g.ndim - 1)))
+    return (ga, gw, gbias)
+
+
+@register_vjp(PrimIDs.EMBEDDING)
+def _embedding_vjp(bsym, g):
+    idx, w = bsym.args
+    if not _is_float_tensor(w):
+        return (None, None)
+    return (None, prims.embedding_backward(g, idx, w.shape[0], w.shape[1]))
+
+
+# =============================================================================
+# The reverse walk
+# =============================================================================
+
+_SKIP_IDS = {
+    PrimIDs.RETURN,
+    PrimIDs.DEL,
+    PrimIDs.COMMENT,
+    PrimIDs.PRINT,
+    PrimIDs.UNPACK_TRIVIAL,
+    PrimIDs.UNPACK_SEQUENCE,
+    PrimIDs.UNPACK_KEY,
+    PrimIDs.UNPACK_ATTR,
+    PrimIDs.CHECK_TENSOR_SHAPE_AND_METADATA,
+    PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE,
+    PrimIDs.CHECK_STRING_VALUE,
+    PrimIDs.CHECK_LEN,
+    PrimIDs.CHECK_NONE,
+}
+
+
+def flatten_for_autodiff(bsyms: Sequence[BoundSymbol]) -> list[BoundSymbol]:
+    """Expand composite bound symbols until each has a VJP rule or is a prim."""
+    out: list[BoundSymbol] = []
+    for b in bsyms:
+        if b.sym.id in _SKIP_IDS:
+            continue
+        if b.sym.id in _vjp_rules or b.sym.is_prim:
+            out.append(b)
+        elif b.subsymbols:
+            out.extend(flatten_for_autodiff(b.subsymbols))
+        else:
+            raise NotImplementedError(f"No VJP rule or decomposition for {b.sym.qualname}")
+    return out
+
+
+class BackwardBuilder:
+    """Reverse-walks a flattened primal program, emitting VJP ops into the
+    active trace and accumulating cotangents per primal proxy."""
+
+    def __init__(self):
+        self.env: dict[Variable, Any] = {}
+
+    def seed(self, proxy: TensorProxy, cotangent) -> None:
+        self.accumulate(proxy, cotangent)
+
+    def accumulate(self, proxy: Proxy, cotangent) -> None:
+        if cotangent is None or not isinstance(proxy, TensorProxy):
+            return
+        v = variableify(proxy)
+        prev = self.env.get(v)
+        self.env[v] = cotangent if prev is None else clang.add(prev, cotangent)
+
+    def cotangent_of(self, proxy: Proxy):
+        return self.env.get(variableify(proxy))
+
+    def run(self, flat_bsyms: Sequence[BoundSymbol]) -> None:
+        for bsym in reversed(flat_bsyms):
+            outs = bsym.flat_proxy_outs
+            cts = [self.env.get(variableify(o)) for o in outs]
+            if not any(c is not None for c in cts):
+                continue
+            rule = _vjp_rules.get(bsym.sym.id)
+            if rule is NONDIFF:
+                continue
+            if rule is None:
+                raise NotImplementedError(f"No VJP rule for prim {bsym.sym.qualname}")
+            # Multi-output prims get a cotangent slot per output (None where
+            # no gradient flows); single-output prims get exactly one.
+            grads = rule(bsym, *cts)
+            self._accumulate_grads(bsym.args, grads)
+
+    def _accumulate_grads(self, args, grads) -> None:
+        for a, g in zip(args, grads):
+            if g is None:
+                continue
+            if isinstance(a, (tuple, list)):
+                for ai, gi in zip(a, g):
+                    if gi is not None and isinstance(ai, TensorProxy):
+                        self.accumulate(ai, _unbroadcast_if_needed(gi, ai))
+            elif isinstance(a, TensorProxy):
+                self.accumulate(a, _unbroadcast_if_needed(g, a))
+
+
+def _unbroadcast_if_needed(g, a: TensorProxy):
+    if isinstance(g, TensorProxy) and tuple(g.shape) != tuple(a.shape):
+        return _unbroadcast(g, tuple(a.shape))
+    return g
+
+
+# =============================================================================
+# Joint grad trace (thunder_tpu.grad / value_and_grad)
+# =============================================================================
+
+
+def grad_transform(
+    trace: TraceCtx,
+    *,
+    return_value: bool = True,
+    wrt: Optional[Sequence[TensorProxy]] = None,
+) -> TraceCtx:
+    """Primal trace → joint trace computing (value, grads).
+
+    The primal output must be a scalar float tensor (a loss). ``wrt`` defaults
+    to the trace's float tensor args marked requires_grad, else all float
+    tensor args. Grads are returned in ``wrt`` order.
+
+    Reference parity: the `grad` transform (thunder/core/transforms.py:1295),
+    re-designed joint-trace-first for XLA: the whole (fw+bw) program stages
+    under one ``jax.jit``, letting XLA schedule and fuse across the
+    fw/bw boundary rather than crossing a host autograd engine.
+    """
+    start = time.perf_counter_ns()
+    flat_out, _ = tree_flatten(trace.output)
+    out_tensors = [o for o in flat_out if isinstance(o, TensorProxy)]
+    check(len(out_tensors) == 1 and out_tensors[0].numel == 1,
+          lambda: "grad requires a single scalar tensor output (the loss)")
+    loss = out_tensors[0]
+
+    if wrt is None:
+        wrt = [a for a in trace.args if _is_float_tensor(a) and a.requires_grad]
+        if not wrt:
+            wrt = [a for a in trace.args if _is_float_tensor(a)]
+    check(len(wrt) > 0, lambda: "grad: no differentiable inputs")
+
+    flat = flatten_for_autodiff(trace.bound_symbols)
+
+    gtrace = from_trace(trace)
+    # Extend in place: _scopes[0] aliases bound_symbols, and the reverse walk
+    # below records through the scope machinery.
+    gtrace.bound_symbols.extend(flat)
+
+    with tracectx(gtrace):
+        seed = clang.full(tuple(loss.shape), 1.0, device=loss.device, dtype=loss.dtype)
+        builder = BackwardBuilder()
+        builder.seed(loss, seed)
+        builder.run(flat)
+        grads = tuple(
+            builder.cotangent_of(p) if builder.cotangent_of(p) is not None else _zeros_for(p) for p in wrt
+        )
+        result = (trace.output, grads) if return_value else grads
+        prims.python_return(result)
+
+    gtrace.output = result
+    gtrace = wrap_in_trace_provenance(gtrace, "Grad transform (joint fw+bw)", start)
+    return dce(gtrace)
+
+
+# =============================================================================
+# Split fw/bw traces (torch-autograd bridge, remat, distributed passes)
+# =============================================================================
+
+
+def forward_and_backward_from_trace(trace: TraceCtx, *, wrt: Optional[Sequence[TensorProxy]] = None):
+    """Primal trace → (fw_trace, bw_trace).
+
+    fw returns (outputs, saved_for_backward); bw takes (saved...,
+    cotangents...) and returns grads for ``wrt`` (default: requires_grad
+    float args, else all float args).
+
+    Reference parity: transforms.py `forward_and_backward_from_trace:3815` +
+    the saved-for-backward filtering `:3930-3963`. Saved-for-backward is
+    computed exactly: the primal proxies the emitted backward program
+    references.
+    """
+    start = time.perf_counter_ns()
+    flat_out, out_spec = tree_flatten(trace.output)
+    out_tensors = [o for o in flat_out if isinstance(o, TensorProxy)]
+    check(len(out_tensors) > 0, lambda: "No tensor outputs to differentiate")
+
+    if wrt is None:
+        wrt = [a for a in trace.args if _is_float_tensor(a) and a.requires_grad]
+        if not wrt:
+            wrt = [a for a in trace.args if _is_float_tensor(a)]
+
+    flat = flatten_for_autodiff(trace.bound_symbols)
+
+    # --- backward trace ------------------------------------------------------
+    bw_trace = from_trace(trace)
+    bw_trace.name = "backward"
+
+    with tracectx(bw_trace):
+        cotangents = [TensorProxy(like=o, requires_grad=False, prefix="ct") for o in out_tensors]
+        builder = BackwardBuilder()
+        for o, ct in zip(out_tensors, cotangents):
+            builder.seed(o, ct)
+        builder.run(flat)
+        grads = tuple(
+            builder.cotangent_of(p) if builder.cotangent_of(p) is not None else _zeros_for(p) for p in wrt
+        )
+        prims.python_return(grads)
+    bw_trace.output = grads
+
+    # --- saved-for-backward: primal proxies the backward references ----------
+    defined_in_bw: set[str] = {ct.name for ct in cotangents}
+    saved_names: list[str] = []
+    saved_proxies: list[Proxy] = []
+    primal_defined: dict[str, Proxy] = {}
+    for a in trace.args:
+        if isinstance(a, Proxy):
+            primal_defined[a.name] = a
+    for b in flat:
+        for o in b.flat_proxy_outs:
+            primal_defined[o.name] = o
+    for b in bw_trace.bound_symbols:
+        for o in b.flat_proxy_outs:
+            defined_in_bw.add(o.name)
+        for a in b.flat_proxy_args:
+            if a.name not in defined_in_bw and a.name not in saved_names:
+                check(a.name in primal_defined, lambda: f"backward references unknown proxy {a.name}")
+                saved_names.append(a.name)
+                saved_proxies.append(primal_defined[a.name])
+
+    bw_trace.args = tuple(saved_proxies) + tuple(cotangents)
+
+    # --- forward trace -------------------------------------------------------
+    fw_trace = from_trace(trace)
+    fw_trace.name = "augmented_forward"
+    fw_trace.bound_symbols.extend(flat)
+    fw_output = (trace.output, tuple(saved_proxies))
+    with tracectx(fw_trace):
+        prims.python_return(fw_output)
+    fw_trace.output = fw_output
+
+    fw_trace = dce(fw_trace)
+    bw_trace = dce(bw_trace)
+    fw_trace = wrap_in_trace_provenance(fw_trace, "Augmented forward", start)
+    bw_trace = wrap_in_trace_provenance(bw_trace, "Backward from VJP", start)
+    fw_trace.tags["saved_for_backward"] = saved_names
+    return fw_trace, bw_trace
