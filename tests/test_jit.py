@@ -124,7 +124,8 @@ def test_rng_functionalization():
     from thunder_tpu.core import devices as tdevices
 
     def foo(a):
-        noise = clang.uniform((3, 3), 0.0, 1.0, device=tdevices.Device("cpu"), dtype=None)
+        # Default device = where host inputs are staged (the accelerator).
+        noise = clang.uniform((3, 3), 0.0, 1.0, device=tdevices.Device(), dtype=None)
         return clang.add(a, noise)
 
     jfoo = ttpu.jit(foo)

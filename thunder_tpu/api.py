@@ -173,9 +173,10 @@ def trace_program(fn: Callable, args: tuple, kwargs: dict) -> tuple[TraceCtx, Tr
         proxied_args = _proxify_tree(args, comp_trc)
         proxied_kwargs = _proxify_tree(kwargs, comp_trc)
 
-    leaves: list = []
-    _collect_leaves(proxied_args, leaves)
-    _collect_leaves(proxied_kwargs, leaves)
+    # Canonical leaf order = jax.tree_util flatten order (sorted dict keys),
+    # so grads, prologue outputs, and computation args all align with what
+    # tree_flatten(params) gives the user.
+    leaves, _ = tree_flatten((proxied_args, proxied_kwargs))
     tensor_leaves = [p for p in leaves if isinstance(p, TensorProxy)]
 
     comp_trc.args = tuple(tensor_leaves)

@@ -608,7 +608,9 @@ def tensorproxy_from_concrete(x: Any, *, name: Optional[str] = None) -> Optional
 
     mod = type(x).__module__
     if isinstance(x, np.ndarray):
-        return TensorProxy(name=name, shape=x.shape, device=devices.cpu, dtype=dtypes.from_jax_dtype(x.dtype))
+        # Host data is device_put to the default accelerator at execution, so
+        # it traces as that device (keeps single-program traces on one device).
+        return TensorProxy(name=name, shape=x.shape, device=devices.Device(), dtype=dtypes.from_jax_dtype(x.dtype))
     if mod.startswith("jax") and hasattr(x, "dtype") and hasattr(x, "shape"):
         try:
             plat = list(x.devices())[0].platform if hasattr(x, "devices") else "cpu"
@@ -617,10 +619,11 @@ def tensorproxy_from_concrete(x: Any, *, name: Optional[str] = None) -> Optional
         dev = devices.Device("cpu" if plat == "cpu" else "tpu")
         return TensorProxy(name=name, shape=x.shape, device=dev, dtype=dtypes.from_jax_dtype(x.dtype))
     if mod.startswith("torch") and hasattr(x, "dtype") and hasattr(x, "layout"):
+        dev = devices.Device() if x.device.type == "cpu" else devices.to_device(x.device)
         return TensorProxy(
             name=name,
             shape=tuple(x.shape),
-            device=devices.to_device(x.device),
+            device=dev,
             dtype=dtypes.from_torch_dtype(x.dtype),
             requires_grad=bool(getattr(x, "requires_grad", False)),
         )

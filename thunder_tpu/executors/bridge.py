@@ -72,15 +72,21 @@ def to_torch(x: Any) -> Any:
     return x
 
 
+def _staging_device() -> str:
+    """Host containers (numpy, torch-CPU tensors) are device_put to the
+    default accelerator when the staged program runs, so they trace as that
+    device — keeping single-program traces on one device instead of
+    spuriously mixing cpu/tpu."""
+    from thunder_tpu.core import devices
+
+    return str(devices.Device())
+
+
 def tensor_metadata(x: Any) -> tuple:
     """(shape, device_str, framework dtype, requires_grad) of a concrete tensor."""
     if is_torch_tensor(x):
-        return (
-            tuple(x.shape),
-            str(x.device),
-            dtypes.from_torch_dtype(x.dtype),
-            bool(x.requires_grad),
-        )
+        dev = _staging_device() if x.device.type == "cpu" else str(x.device)
+        return tuple(x.shape), dev, dtypes.from_torch_dtype(x.dtype), bool(x.requires_grad)
     import jax
 
     if isinstance(x, jax.Array):
@@ -92,7 +98,7 @@ def tensor_metadata(x: Any) -> tuple:
     import numpy as np
 
     if isinstance(x, np.ndarray):
-        return tuple(x.shape), "cpu", dtypes.from_jax_dtype(x.dtype), False
+        return tuple(x.shape), _staging_device(), dtypes.from_jax_dtype(x.dtype), False
     raise ValueError(f"Not a tensor: {type(x)}")
 
 

@@ -58,6 +58,13 @@ def transform_for_execution(trace: TraceCtx, executors_list: Sequence[Executor])
             # Host-side op with an inline implementation (guards etc.)
             new_bsyms.append(bsym)
             return
+        if not bsym.subsymbols:
+            # A composite whose decomposition recorded nothing is an identity
+            # (e.g. ``x[...]`` with full slices, dropout(p=0)): its outputs
+            # ARE its input proxies, so the op can simply be dropped.
+            arg_vars = {variableify(p) for p in bsym.flat_proxy_args}
+            if all(variableify(o) in arg_vars for o in bsym.flat_proxy_outs):
+                return
         check(
             len(bsym.subsymbols) > 0,
             lambda: f"No executor for primitive {bsym.sym.qualname} (id {bsym.sym.id})",

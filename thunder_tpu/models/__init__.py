@@ -1,0 +1,14 @@
+"""Model zoo: litgpt-style transformer family used by the reference's
+benchmarks (reference: thunder/tests/lit_gpt_model.py, litgpt's GPT —
+pythia/llama/mistral configs exercised in
+thunder/benchmarks/benchmark_litgpt.py).
+"""
+
+from thunder_tpu.models.gpt import (  # noqa: F401
+    GPTConfig,
+    configs,
+    forward,
+    init_params,
+    loss_fn,
+    name_to_config,
+)

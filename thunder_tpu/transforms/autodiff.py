@@ -611,6 +611,11 @@ def flatten_for_autodiff(bsyms: Sequence[BoundSymbol]) -> list[BoundSymbol]:
         elif b.subsymbols:
             out.extend(flatten_for_autodiff(b.subsymbols))
         else:
+            # Identity composite (e.g. full-slice getitem): outputs ARE input
+            # proxies, nothing to record or differentiate through.
+            arg_vars = {variableify(p) for p in b.flat_proxy_args}
+            if all(variableify(o) in arg_vars for o in b.flat_proxy_outs):
+                continue
             raise NotImplementedError(f"No VJP rule or decomposition for {b.sym.qualname}")
     return out
 
