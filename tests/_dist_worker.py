@@ -1,0 +1,157 @@
+"""Multi-device test scenarios, run in a clean-env subprocess with
+``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``.
+
+Reference parity: thunder/tests/distributed/test_ddp.py spawns one OS
+process per rank over NCCL; on TPU a single process drives N devices, so
+one subprocess with a virtual 8-CPU mesh covers the same semantics
+(SURVEY.md §4: "strictly better than the reference's multi-process-only
+story"). Invoked by tests/test_distributed.py.
+"""
+
+import sys
+
+import numpy as np
+
+
+def scenario_collectives():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from thunder_tpu.distributed import prims as dist
+    from thunder_tpu.distributed.runtime import compile_with_collectives
+    from thunder_tpu.parallel import make_mesh
+
+    assert len(jax.devices()) == 8, jax.devices()
+    mesh = make_mesh(dp=8)
+
+    x = np.arange(16, dtype=np.float32).reshape(8, 2)
+
+    def f(a):
+        s = dist.all_reduce(a, "dp", 8)
+        g = dist.all_gather(a, "dp", 8)
+        rs = dist.reduce_scatter(g, "dp", 8)
+        return s, g, rs
+
+    jf, extrace = compile_with_collectives(f, (x[:1],), mesh, (P("dp", None),), (P(), P(None, None), P("dp", None)))
+    s, g, rs = jf(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(s), x.sum(0, keepdims=True))
+    np.testing.assert_allclose(np.asarray(g), x)
+    # g is replicated across devices, so reduce_scatter sums 8 copies of each row block
+    np.testing.assert_allclose(np.asarray(rs), 8.0 * x)
+    src = extrace.python()
+    assert "all_reduce" in src and "all_gather" in src and "reduce_scatter" in src
+    print("collectives OK")
+
+
+def scenario_ddp_train():
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.core.pytree import tree_map
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.parallel import build_train_step, make_mesh
+    from thunder_tpu.parallel.sharding import gpt_param_specs
+
+    mesh = make_mesh(dp=8)
+    cfg = m.name_to_config("gpt-tiny")
+    params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+    # DDP: replicated params
+    specs = tree_map(lambda _: P(), params)
+
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, cfg.vocab_size, (16, 32)).astype(np.int32)
+    tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+
+    step, opt = build_train_step(cfg, params, idx, tgt, mesh=mesh, param_specs=specs, lr=1e-2)
+    losses = []
+    for _ in range(5):
+        params, opt, loss = step(params, opt, idx, tgt)
+        losses.append(float(np.asarray(loss)))
+    assert losses[-1] < losses[0], losses
+    print("ddp_train OK", losses[0], "->", losses[-1])
+
+
+def scenario_fsdp_train():
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.parallel import build_train_step, make_mesh
+    from thunder_tpu.parallel.sharding import data_spec, gpt_param_specs
+
+    cfg = m.name_to_config("llama-tiny")
+    params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, cfg.vocab_size, (16, 32)).astype(np.int32)
+    tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+
+    # Single-device baseline
+    step0, opt0 = build_train_step(cfg, params, idx, tgt, lr=1e-2, donate=False)
+    p0, o0, loss0_a = step0(params, opt0, idx, tgt)
+    _, _, loss0_b = step0(p0, o0, idx, tgt)
+
+    # FSDP over 8 devices
+    mesh = make_mesh(fsdp=8)
+    specs = gpt_param_specs(cfg, mesh, tp=False)
+    step, opt = build_train_step(cfg, params, idx, tgt, mesh=mesh, param_specs=specs, lr=1e-2, donate=False)
+    p1, o1, loss1_a = step(params, opt, idx, tgt)
+    _, _, loss1_b = step(p1, o1, idx, tgt)
+
+    np.testing.assert_allclose(float(loss1_a), float(loss0_a), rtol=1e-5)
+    np.testing.assert_allclose(float(loss1_b), float(loss0_b), rtol=1e-4)
+
+    # Params actually sharded: per-shard bytes ≈ total/8 for the big weights
+    wte = p1["wte"]
+    shard_elems = wte.addressable_shards[0].data.size
+    assert shard_elems * 8 == wte.size, (shard_elems, wte.size)
+    print("fsdp_train OK", float(loss0_a), float(loss1_b))
+
+
+def scenario_tp_fsdp_train():
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.parallel import build_train_step, make_mesh
+    from thunder_tpu.parallel.sharding import gpt_param_specs
+
+    cfg = m.name_to_config("llama-tiny")
+    params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+    tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+
+    step0, opt0 = build_train_step(cfg, params, idx, tgt, lr=1e-2, donate=False)
+    _, _, loss0 = step0(params, opt0, idx, tgt)
+
+    mesh = make_mesh(dp=2, fsdp=2, tp=2)
+    specs = gpt_param_specs(cfg, mesh)
+    step, opt = build_train_step(cfg, params, idx, tgt, mesh=mesh, param_specs=specs, lr=1e-2, donate=False)
+    p, o, loss = step(params, opt, idx, tgt)
+    np.testing.assert_allclose(float(loss), float(loss0), rtol=1e-5)
+    print("tp_fsdp_train OK", float(loss))
+
+
+def scenario_fsdp_api():
+    import jax
+
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.distributed import fsdp
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.parallel import make_mesh
+
+    mesh = make_mesh(fsdp=8)
+    cfg = m.name_to_config("gpt-tiny")
+    params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+    sharded = fsdp(params, mesh=mesh)
+    wte = sharded["wte"]
+    assert wte.addressable_shards[0].data.shape[0] * 8 == wte.shape[0]
+    print("fsdp_api OK")
+
+
+if __name__ == "__main__":
+    scenario = sys.argv[1]
+    globals()[f"scenario_{scenario}"]()

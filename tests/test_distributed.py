@@ -1,0 +1,144 @@
+"""Distributed: trace-level collective IR tests (in-process, device-free)
+and multi-device execution tests (clean-env subprocess, 8 virtual CPU
+devices).
+
+Reference parity: thunder/tests/distributed/test_ddp.py (multi-process
+NCCL, world_size 2) + the trace-text assertions the reference uses for
+bucketing/collective rewrites (SURVEY.md §4).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import thunder_tpu  # noqa: E402
+from thunder_tpu.core.proxies import DistParallelType, FutureTensorProxy, TensorProxy  # noqa: E402
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_scenario(name: str, timeout: int = 420):
+    env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": os.environ.get("HOME", "/root"),
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    }
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests", "_dist_worker.py"), name],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    assert r.returncode == 0, f"scenario {name} failed:\nstdout:{r.stdout}\nstderr:{r.stderr[-3000:]}"
+    assert "OK" in r.stdout
+
+
+# -- trace-level (device-free) -----------------------------------------------
+
+
+class TestCollectiveIR:
+    def test_synchronize_vjp_ddp(self):
+        """Replicated param: backward contains pre-scaled all_reduce
+        (reference: distributed/prims.py:286-298)."""
+        from thunder_tpu.api import trace_program
+        from thunder_tpu.distributed import prims as dist
+        from thunder_tpu.transforms.autodiff import grad_transform
+        from thunder_tpu.transforms.common import dce
+        import thunder_tpu.torch as ttorch
+
+        def f(w, x):
+            w2 = dist.synchronize(w, "dp", 8)
+            return ttorch.sum(ttorch.linear(x, w2) ** 2.0)
+
+        w = np.random.randn(4, 4).astype(np.float32)
+        x = np.random.randn(2, 4).astype(np.float32)
+        _, comp = trace_program(f, (w, x), {})
+        g = grad_transform(dce(comp))
+        src = g.python()
+        assert "synchronize" in src
+        assert "all_reduce" in src  # grad sync
+        assert "0.125" in src  # pre-divide by world size
+
+    def test_synchronize_vjp_fsdp(self):
+        """Sharded param: forward all-gathers, backward reduce-scatters."""
+        from thunder_tpu.api import trace_program
+        from thunder_tpu.distributed import prims as dist
+        from thunder_tpu.transforms.autodiff import grad_transform
+        from thunder_tpu.transforms.common import dce
+        import thunder_tpu.torch as ttorch
+        from thunder_tpu.core.trace import tracectx, TraceCtx
+
+        # Build a trace whose param proxy is marked FULLY_SHARDED.
+        def f(w_shard, x):
+            w = dist.synchronize(w_shard, "fsdp", 4)
+            return ttorch.sum(ttorch.linear(x, w) ** 2.0)
+
+        w = np.random.randn(2, 8).astype(np.float32)  # dim-0 shard (full: 8)
+        x = np.random.randn(3, 8).astype(np.float32)
+        _, comp = trace_program(f, (w, x), {})
+        # Mark the first arg proxy as sharded, as fsdp() would.
+        comp.args[0].dist_parallel_type = DistParallelType.FULLY_SHARDED
+        # Re-trace: synchronize meta keys off dist_parallel_type; simplest is
+        # to re-run tracing with the marked proxy — here we instead inspect
+        # the ALL_GATHER lowering path via a fresh trace.
+        from thunder_tpu.core.proxies import DistParallelType as DPT
+
+        def f2(w_shard, x):
+            w_shard.dist_parallel_type = DPT.FULLY_SHARDED
+            w = dist.synchronize(w_shard, "fsdp", 4)
+            return ttorch.sum(ttorch.linear(x, w) ** 2.0)
+
+        _, comp2 = trace_program(f2, (w, x), {})
+        g = grad_transform(dce(comp2))
+        src = g.python()
+        assert "synchronize" in src
+        assert "reduce_scatter" in src  # FSDP grad sync
+        assert "0.25" in src  # pre-divide by world size
+
+    def test_all_gather_meta_shapes(self):
+        from thunder_tpu.core.trace import detached_trace
+        from thunder_tpu.distributed import prims as dist
+
+        with detached_trace():
+            t = TensorProxy(shape=(2, 3), dtype=None, device="cpu")
+            out = dist.all_gather(t, "dp", 4)
+            assert tuple(out.shape) == (8, 3)
+            fut = dist.all_gather(t, "dp", 4, async_op=True)
+            assert isinstance(fut, FutureTensorProxy)
+            waited = dist.wait(fut)
+            assert not isinstance(waited, FutureTensorProxy)
+            assert tuple(waited.shape) == (8, 3)
+            rs = dist.reduce_scatter(t, "dp", 2, dim=0)
+            assert tuple(rs.shape) == (1, 3)
+
+    def test_no_sync_context(self):
+        from thunder_tpu.distributed import no_sync, skip_data_parallel_grad_sync
+
+        assert not skip_data_parallel_grad_sync()
+        with no_sync():
+            assert skip_data_parallel_grad_sync()
+        assert not skip_data_parallel_grad_sync()
+
+
+# -- multi-device execution (subprocess, 8 virtual CPU devices) ---------------
+
+
+class TestMultiDevice:
+    def test_collectives(self):
+        _run_scenario("collectives")
+
+    def test_ddp_train(self):
+        _run_scenario("ddp_train")
+
+    def test_fsdp_train(self):
+        _run_scenario("fsdp_train")
+
+    def test_tp_fsdp_train(self):
+        _run_scenario("tp_fsdp_train")
+
+    def test_fsdp_api(self):
+        _run_scenario("fsdp_api")

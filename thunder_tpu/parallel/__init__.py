@@ -1,0 +1,21 @@
+"""TPU-native parallelism: device meshes, sharding plans, sharded train steps.
+
+This package is the performance path that takes the seat of the reference's
+`thunder/distributed/` NCCL machinery (reference: distributed/__init__.py
+`ddp:88` / `fsdp:303`, bucketing, `sort_waits` comm scheduling): on TPU the
+mesh + PartitionSpec annotations let XLA's SPMD partitioner insert and
+schedule collectives over ICI/DCN, replacing hand-written bucketing and wait
+sorting (SURVEY.md §5 "Distributed communication backend").
+
+Explicit trace-level collectives (the reference's distributed/prims.py
+surface) live in ``thunder_tpu.distributed``.
+"""
+
+from thunder_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: F401
+from thunder_tpu.parallel.sharding import (  # noqa: F401
+    data_spec,
+    gpt_param_specs,
+    named_shardings,
+    shard_pytree,
+)
+from thunder_tpu.parallel.train import adamw_init, adamw_update, build_train_step  # noqa: F401

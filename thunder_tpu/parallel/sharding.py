@@ -1,0 +1,157 @@
+"""Sharding plans for the GPT param pytree.
+
+Reference parity: thunder/distributed/__init__.py `fsdp:303` dim-0
+per-parameter sharding (`_shard_param:406`) and `ddp:88` replication —
+re-expressed as PartitionSpecs so XLA's SPMD partitioner takes the seats of
+the all-gather/reduce-scatter rewrites (transforms/fsdp.py), bucketing
+(bucketing.py), and wait sorting (distributed/utils.py `sort_waits:115`).
+
+Plans compose:
+- **FSDP** (ZeRO): every weight sharded on its *largest* dim over the
+  ``fsdp`` axis; params are all-gathered just-in-time per layer by the
+  partitioner, grads reduce-scattered — the ZeRO-3 dataflow of the
+  reference's `rematerialize_all_gather` without a bespoke pass.
+- **TP** (Megatron): qkv/fc projections column-parallel, output projections
+  row-parallel, so each block needs a single psum per matmul pair riding ICI.
+- **DP**: the batch dim of activations shards over (dp, fsdp) jointly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from thunder_tpu.models.gpt import GPTConfig
+
+
+def _P(*parts):
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*parts)
+
+
+def _axis(mesh, name: str) -> Optional[str]:
+    """Axis name if present in the mesh with size > 1, else None."""
+    if mesh is None:
+        return None
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return name if sizes.get(name, 1) > 1 else None
+
+
+def _div(n: int, axis_size: int) -> bool:
+    return axis_size > 0 and n % axis_size == 0
+
+
+def gpt_param_specs(config: GPTConfig, mesh, *, fsdp: bool = True, tp: bool = True) -> dict:
+    """PartitionSpec pytree matching ``models.gpt.init_params`` structure."""
+    fs = _axis(mesh, "fsdp") if fsdp else None
+    tpx = _axis(mesh, "tp") if tp else None
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape)) if mesh is not None else {}
+    fs_n = sizes.get("fsdp", 1)
+    tp_n = sizes.get("tp", 1)
+
+    C = config
+
+    def spec2d(rows: int, cols: int, *, col_parallel: Optional[bool]):
+        """(rows, cols) weight: TP on the compute-parallel dim, FSDP on the
+        other (or on rows when no TP)."""
+        row_ax = col_ax = None
+        if col_parallel is True and tpx and _div(rows, tp_n):
+            row_ax = tpx
+        elif col_parallel is False and tpx and _div(cols, tp_n):
+            col_ax = tpx
+        if fs:
+            if row_ax is None and _div(rows, fs_n):
+                row_ax = (row_ax, fs) if row_ax else fs
+            elif col_ax is None and _div(cols, fs_n):
+                col_ax = fs
+        return _P(row_ax, col_ax)
+
+    def norm_spec(p: dict) -> dict:
+        return {k: _P(None) for k in p}
+
+    def block_spec(blk: dict) -> dict:
+        s: dict[str, Any] = {
+            "norm_1": norm_spec(blk["norm_1"]),
+            "attn": {},
+            "mlp": {},
+        }
+        if "norm_2" in blk:
+            s["norm_2"] = norm_spec(blk["norm_2"])
+        a = blk["attn"]
+        s["attn"]["qkv_w"] = spec2d(C.qkv_out, C.n_embd, col_parallel=True)
+        s["attn"]["proj_w"] = spec2d(C.n_embd, C.n_head * C.head_size, col_parallel=False)
+        if "qkv_b" in a:
+            s["attn"]["qkv_b"] = _P(tpx if tpx and _div(C.qkv_out, tp_n) else None)
+        if "proj_b" in a:
+            s["attn"]["proj_b"] = _P(None)
+        mlp = blk["mlp"]
+        hidden = C.mlp_hidden
+        if "fc_1_w" in mlp:
+            s["mlp"]["fc_1_w"] = spec2d(hidden, C.n_embd, col_parallel=True)
+            s["mlp"]["fc_2_w"] = spec2d(hidden, C.n_embd, col_parallel=True)
+            s["mlp"]["proj_w"] = spec2d(C.n_embd, hidden, col_parallel=False)
+        if "fc_w" in mlp:
+            s["mlp"]["fc_w"] = spec2d(hidden, C.n_embd, col_parallel=True)
+            s["mlp"]["proj_w"] = spec2d(C.n_embd, hidden, col_parallel=False)
+        for b_name in ("fc_1_b", "fc_2_b", "fc_b"):
+            if b_name in mlp:
+                s["mlp"][b_name] = _P(tpx if tpx and _div(hidden, tp_n) else None)
+        if "proj_b" in mlp:
+            s["mlp"]["proj_b"] = _P(None)
+        return s
+
+    # Embedding / head: vocab-parallel over tp, fsdp on the other dim.
+    return {
+        "wte": spec2d(C.padded_vocab_size, C.n_embd, col_parallel=True),
+        "blocks": [block_spec(b) for b in _blocks_template(config)],
+        "ln_f": {"weight": _P(None), **({"bias": _P(None)} if C.norm_class == "LayerNorm" else {})},
+        "lm_head_w": spec2d(C.padded_vocab_size, C.n_embd, col_parallel=True),
+    }
+
+
+def _blocks_template(config: GPTConfig) -> list[dict]:
+    """Structure-only template of one block's param dict (no arrays)."""
+    blk: dict[str, Any] = {
+        "norm_1": {"weight": 0, **({"bias": 0} if config.norm_class == "LayerNorm" else {})},
+        "attn": {"qkv_w": 0, "proj_w": 0, **({"qkv_b": 0, "proj_b": 0} if config.bias else {})},
+        "mlp": {},
+    }
+    if not config.shared_attention_norm:
+        blk["norm_2"] = dict(blk["norm_1"])
+    if config.mlp_class == "LLaMAMLP":
+        blk["mlp"] = {"fc_1_w": 0, "fc_2_w": 0, "proj_w": 0}
+        if config.bias:
+            blk["mlp"].update({"fc_1_b": 0, "fc_2_b": 0, "proj_b": 0})
+    else:
+        blk["mlp"] = {"fc_w": 0, "proj_w": 0}
+        if config.bias:
+            blk["mlp"].update({"fc_b": 0, "proj_b": 0})
+    return [blk for _ in range(config.n_layer)]
+
+
+def data_spec(mesh):
+    """Batch sharding for (B, T) token tensors: batch over (dp, fsdp)."""
+    batch_axes = tuple(a for a in ("dp", "fsdp") if _axis(mesh, a))
+    seq_ax = _axis(mesh, "sp")
+    return _P(batch_axes if batch_axes else None, seq_ax)
+
+
+def named_shardings(mesh, specs):
+    from jax.sharding import NamedSharding
+    from thunder_tpu.core.pytree import tree_map
+
+    return tree_map(lambda s: NamedSharding(mesh, s), specs,
+                    is_leaf=lambda x: type(x).__name__ == "PartitionSpec")
+
+
+def shard_pytree(tree, mesh, specs):
+    """device_put a pytree onto the mesh per its spec pytree."""
+    import jax
+    from thunder_tpu.core.pytree import tree_flatten, tree_unflatten
+
+    flat, spec_struct = tree_flatten(tree)
+    flat_specs, _ = tree_flatten(specs, is_leaf=lambda x: type(x).__name__ == "PartitionSpec")
+    from jax.sharding import NamedSharding
+
+    out = [jax.device_put(x, NamedSharding(mesh, s)) for x, s in zip(flat, flat_specs)]
+    return tree_unflatten(spec_struct, out)
