@@ -131,15 +131,31 @@ def name_to_config(name: str) -> GPTConfig:
 # =============================================================================
 
 
-def init_params(config: GPTConfig, *, dtype=dtypes.bfloat16, seed: int = 0) -> dict:
-    """Nested-dict params pytree (numpy arrays; cast/shard downstream)."""
+def init_params(config: GPTConfig, *, dtype=dtypes.bfloat16, seed: int = 0, device_init: bool = False) -> dict:
+    """Nested-dict params pytree.
+
+    ``device_init=False`` (default): reproducible numpy init, suitable for
+    tests and parity checks. ``device_init=True``: weights are generated
+    directly on the accelerator with jax.random — required for multi-GB
+    models where a host-side f32 copy would not fit (and is ~100× faster).
+    """
+    import jax
     import jax.numpy as jnp
 
     rng = np.random.RandomState(seed)
     jdt = dtypes.to_jax_dtype(dtypes.to_dtype(dtype))
 
-    def w(*shape, std=0.02):
-        return jnp.asarray(rng.normal(0.0, std, size=shape).astype(np.float32), dtype=jdt)
+    if device_init:
+        key_holder = {"k": jax.random.PRNGKey(seed)}
+
+        def w(*shape, std=0.02):
+            key_holder["k"], sub = jax.random.split(key_holder["k"])
+            return (jax.random.normal(sub, shape, dtype=jnp.float32) * std).astype(jdt)
+
+    else:
+
+        def w(*shape, std=0.02):
+            return jnp.asarray(rng.normal(0.0, std, size=shape).astype(np.float32), dtype=jdt)
 
     def zeros(*shape):
         return jnp.zeros(shape, dtype=jdt)
