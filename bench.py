@@ -46,17 +46,9 @@ def build(cfg_name: str, batch: int, seq: int):
 def main() -> None:
     import jax
 
-    # The materialized-softmax decomposition needs ~2·(B·H·T²) f32 score
-    # buffers; until the Pallas flash-attention executor claims SDPA, the
-    # B=10 reference workload runs as micro-batches sized to chip HBM
-    # (identical total tokens, so times are directly comparable).
-    hbm_gb = 16
-    try:
-        stats = jax.devices()[0].memory_stats()
-        hbm_gb = stats.get("bytes_limit", 16 << 30) / (1 << 30)
-    except Exception:
-        pass
-    micro = B if hbm_gb > 30 else 5
+    # With the flash-attention executor claiming SDPA there is no (B,H,T,T)
+    # score materialization and the full B=10 fits on a 16 GB chip.
+    micro = B
 
     t_build0 = time.perf_counter()
     flat_fn, flat_args = build("open_llama_3b", micro, T)

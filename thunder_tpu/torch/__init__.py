@@ -915,6 +915,122 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p: f
 
 
 # =============================================================================
+# Backward composites (claimable by fast executors; decompose for fallback)
+# =============================================================================
+
+
+@torchsymbol(id="torch.sdpa_bwd")
+def sdpa_bwd(g, query, key, value, is_causal: bool = False, scale: Optional[float] = None,
+             enable_gqa: bool = False):
+    """(dq, dk, dv) of causal/plain SDPA by recompute — the flash executor
+    replaces this whole op with the Pallas flash-attention backward
+    (reference analogue: cudnnex's sdpa backward graph, cudnnex.py:375)."""
+    E = query.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(E)
+    H = query.shape[-3]
+    G = key.shape[-3]
+
+    k, v = key, value
+    if enable_gqa and G != H:
+        rep = H // G
+        k = repeat_interleave(k, rep, -3)
+        v = repeat_interleave(v, rep, -3)
+
+    qf = clang.maybe_convert_to_dtype(query, dtypes.float32)
+    kf = clang.maybe_convert_to_dtype(k, dtypes.float32)
+    vf = clang.maybe_convert_to_dtype(v, dtypes.float32)
+    gf = clang.maybe_convert_to_dtype(g, dtypes.float32)
+
+    s = clang.mul(clang.matmul(qf, clang.transpose(kf, -2, -1)), scale)
+    S, L = query.shape[-2], key.shape[-2]
+    if is_causal:
+        cmask = clang.diagonal_mask(S, L, offset=L - S, upper=False, device=query.device)
+        s = clang.where(clang.expand_to(cmask, s.shape), s, clang.full_like(s, -float("inf")))
+    p = softmax(s, -1)
+
+    dv = clang.matmul(clang.transpose(p, -2, -1), gf)
+    dp = clang.matmul(gf, clang.transpose(vf, -2, -1))
+    ds = clang.mul(p, clang.sub(dp, clang.sum(clang.mul(dp, p), (-1,), True)))
+    dq = clang.mul(clang.matmul(ds, kf), scale)
+    dk = clang.mul(clang.matmul(clang.transpose(ds, -2, -1), qf), scale)
+
+    if enable_gqa and G != H:
+        rep = H // G
+        bshape = tuple(dk.shape[:-3])
+        dk = clang.sum(clang.reshape(dk, bshape + (G, rep) + tuple(dk.shape[-2:])), (len(bshape) + 1,))
+        dv = clang.sum(clang.reshape(dv, bshape + (G, rep) + tuple(dv.shape[-2:])), (len(bshape) + 1,))
+
+    dq = clang.maybe_convert_to_dtype(dq, query.dtype)
+    dk = clang.maybe_convert_to_dtype(dk, key.dtype)
+    dv = clang.maybe_convert_to_dtype(dv, value.dtype)
+    return dq, dk, dv
+
+
+@torchsymbol(id="torch.cross_entropy_bwd")
+def cross_entropy_bwd(g, input, target, ignore_index: int = -100, reduction: str = "mean"):
+    """dlogits of fused cross-entropy: (softmax − onehot) · g/count. The
+    Pallas executor replaces this whole op (reference analogue: the Triton
+    CE backward kernels, triton_crossentropy.py:270,343)."""
+    N, C = input.shape
+    p = softmax(clang.maybe_convert_to_dtype(input, dtypes.float32), 1)
+    cols = clang.expand_to(clang.arange(0, C, 1, device=input.device, dtype=dtypes.int64), (N, C))
+    onehot = clang.maybe_convert_to_dtype(clang.eq(cols, clang.unsqueeze(clang.maximum(target, 0), 1)),
+                                          dtypes.float32)
+    valid = clang.ne(target, ignore_index)
+    validf = clang.maybe_convert_to_dtype(valid, dtypes.float32)
+    if reduction == "mean":
+        count = clang.maximum(clang.sum(validf, None), 1.0)
+        row_scale = clang.true_divide(clang.mul(g, validf), count)
+    else:  # sum
+        row_scale = clang.mul(g, validf)
+    d = clang.mul(clang.sub(p, onehot), clang.unsqueeze(row_scale, 1))
+    return clang.maybe_convert_to_dtype(d, input.dtype)
+
+
+def _register_composite_vjps():
+    from thunder_tpu.transforms.autodiff import register_vjp
+
+    def _sdpa_args(args, kwargs):
+        names = ("query", "key", "value", "attn_mask", "dropout_p", "is_causal", "scale", "enable_gqa")
+        defaults = {"attn_mask": None, "dropout_p": 0.0, "is_causal": False, "scale": None, "enable_gqa": False}
+        bound = dict(zip(names, args))
+        bound.update(kwargs)
+        for k, dflt in defaults.items():
+            bound.setdefault(k, dflt)
+        return bound
+
+    def _sdpa_checker(*args, **kwargs):
+        b = _sdpa_args(args, kwargs)
+        return b["attn_mask"] is None and float(pyval(b["dropout_p"])) == 0.0
+
+    @register_vjp("torch.scaled_dot_product_attention", checker=_sdpa_checker)
+    def _sdpa_vjp(bsym, g):
+        b = _sdpa_args(bsym.args, bsym.kwargs)
+        dq, dk, dv = sdpa_bwd(g, b["query"], b["key"], b["value"], b["is_causal"], b["scale"], b["enable_gqa"])
+        grads = [None] * len(bsym.args)
+        for i, name in enumerate(("query", "key", "value")):
+            if i < len(bsym.args):
+                grads[i] = (dq, dk, dv)[i]
+        return grads
+
+    def _ce_checker(input, target, weight=None, ignore_index=-100, reduction="mean", label_smoothing=0.0):
+        return weight is None and float(pyval(label_smoothing)) == 0.0 and reduction in ("mean", "sum")
+
+    @register_vjp("torch.cross_entropy", checker=_ce_checker)
+    def _ce_vjp(bsym, g):
+        bound = dict(zip(("input", "target", "weight", "ignore_index", "reduction"), bsym.args))
+        bound.update(bsym.kwargs)
+        d = cross_entropy_bwd(
+            g, bound["input"], bound["target"],
+            bound.get("ignore_index", -100), bound.get("reduction", "mean"),
+        )
+        return (d,) + (None,) * (len(bsym.args) - 1)
+
+
+_register_composite_vjps()
+
+
+# =============================================================================
 # Misc tensor methods
 # =============================================================================
 

@@ -49,13 +49,20 @@ from thunder_tpu.transforms.common import dce
 # bsym.args (None for non-differentiable positions). Rules run under the
 # backward trace's context and may reference any primal proxy.
 _vjp_rules: dict[Any, Callable] = {}
+# Optional applicability predicates: rule used only when checker(bsym) is
+# truthy; otherwise autodiff descends into the op's decomposition. Lets a
+# composite-level rule (e.g. flash-attention SDPA) scope itself to the cases
+# a fast backward exists for.
+_vjp_checkers: dict[Any, Callable] = {}
 
 NONDIFF = object()  # registered marker: op treated as constant
 
 
-def register_vjp(sym_id):
+def register_vjp(sym_id, checker: Optional[Callable] = None):
     def deco(fn):
         _vjp_rules[sym_id] = fn
+        if checker is not None:
+            _vjp_checkers[sym_id] = checker
         return fn
 
     return deco
@@ -606,7 +613,9 @@ def flatten_for_autodiff(bsyms: Sequence[BoundSymbol]) -> list[BoundSymbol]:
     for b in bsyms:
         if b.sym.id in _SKIP_IDS:
             continue
-        if b.sym.id in _vjp_rules or b.sym.is_prim:
+        checker = _vjp_checkers.get(b.sym.id)
+        rule_ok = b.sym.id in _vjp_rules and (checker is None or _checker_accepts(checker, b))
+        if rule_ok or b.sym.is_prim:
             out.append(b)
         elif b.subsymbols:
             out.extend(flatten_for_autodiff(b.subsymbols))
@@ -618,6 +627,13 @@ def flatten_for_autodiff(bsyms: Sequence[BoundSymbol]) -> list[BoundSymbol]:
                 continue
             raise NotImplementedError(f"No VJP rule or decomposition for {b.sym.qualname}")
     return out
+
+
+def _checker_accepts(checker: Callable, bsym: BoundSymbol) -> bool:
+    try:
+        return bool(checker(*bsym.args, **bsym.kwargs))
+    except Exception:
+        return False
 
 
 class BackwardBuilder:
