@@ -14,6 +14,7 @@ Public surface mirrors the reference's thunder/__init__.py: `jit`,
 __version__ = "0.1.0"
 
 from thunder_tpu.core import dtypes, devices  # noqa: F401
+from thunder_tpu import torch as _ltorch  # register the torch-mirror language  # noqa: F401
 from thunder_tpu.api import (  # noqa: F401
     jit,
     grad,

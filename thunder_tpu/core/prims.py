@@ -625,7 +625,8 @@ def _tensor_from_sequence_meta(seq: Any, *, device: devices.Device, dtype: Optio
     # Infer shape/dtype from the (nested) sequence of numbers.
     def shape_of(s):
         if isinstance(s, (list, tuple)):
-            check(len(s) > 0, "Cannot infer shape from an empty sequence")
+            if len(s) == 0:
+                return (0,)
             inner = shape_of(s[0])
             return (len(s),) + inner
         return ()
@@ -638,7 +639,12 @@ def _tensor_from_sequence_meta(seq: Any, *, device: devices.Device, dtype: Optio
     shape = shape_of(seq)
     if dtype is None:
         lv = leaf(seq)
-        dtype = dtypes.to_strong(dtypes.numbertype_to_dtype(type(pyval(lv)) if isinstance(lv, NumberProxy) else type(lv)))
+        if isinstance(lv, (list, tuple)):  # fully empty sequence
+            dtype = dtypes.float32
+        else:
+            dtype = dtypes.to_strong(
+                dtypes.numbertype_to_dtype(type(pyval(lv)) if isinstance(lv, NumberProxy) else type(lv))
+            )
         if dtype == dtypes.float64:
             dtype = dtypes.float32
     return TensorProxy(shape=shape, device=devices.to_device(device), dtype=dtype)

@@ -390,6 +390,16 @@ class TensorProxy(Proxy):
     def replace_name(self, name: str) -> "TensorProxy":
         return self.replace(name=name)
 
+    @classmethod
+    def __torch_function__(cls, func, types, args=(), kwargs=None):
+        """torch-dispatch hook: makes torch's C++ argument parsers accept
+        proxies in Tensor positions and routes the call to the ltorch mirror
+        (the frontend seat of the reference's interpreter lookasides,
+        thunder/core/jit_ext.py `general_jit_lookaside:871`)."""
+        from thunder_tpu.frontend.dispatch import torch_dispatch
+
+        return torch_dispatch(func, types, args, kwargs)
+
     def replace(self, name: Optional[str] = None, **changes) -> "TensorProxy":
         p = TensorProxy(
             name=name,

@@ -1,0 +1,12 @@
+"""Program-acquisition frontends.
+
+Reference parity: thunder/core/jit_ext.py + interpreter.py acquire PyTorch
+programs by interpreting CPython bytecode against proxies. The TPU build
+acquires them by *dispatch interception* instead: a ``TorchFunctionMode``
+routes every ``torch.*`` call to the ltorch mirror while module parameters
+are swapped for proxies — no bytecode VM, same trace out the other end
+(and Python-version-independent, where the reference's interpreter is
+gated per CPython version, interpreter.py:1114).
+"""
+
+from thunder_tpu.frontend.module import ThunderModule, thunder_module  # noqa: F401

@@ -1,0 +1,40 @@
+"""Shared torch→ltorch dispatch used by both TensorProxy.__torch_function__
+and the tracing TorchFunctionMode.
+
+Two hooks are needed because torch's dispatcher engages them at different
+points: a type defining ``__torch_function__`` makes the C++ argument
+parsers accept proxies in Tensor positions (``F.linear(proxy, w)``), while
+the mode intercepts calls with *no* tensor-like argument at all
+(``torch.ones(...)`` factories inside a traced forward).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def torch_dispatch(func, types, args=(), kwargs=None):
+    kwargs = kwargs or {}
+    from thunder_tpu.core.langctxs import Languages, resolve_language
+    from thunder_tpu.core.proxies import TensorProxy
+    from thunder_tpu.core.pytree import tree_flatten
+    from thunder_tpu.torch import torch_function_map
+
+    target = torch_function_map().get(func)
+    if target is not None:
+        return target(*args, **kwargs)
+
+    flat, _ = tree_flatten((args, kwargs))
+    if not any(isinstance(a, TensorProxy) for a in flat):
+        # Pure-torch call over concrete values (dtype queries, flag checks):
+        # run it for real.
+        return func(*args, **kwargs)
+
+    name = getattr(func, "__name__", None)
+    ctx = resolve_language(Languages.TORCH)
+    if name and ctx.has_method(name):
+        return ctx.get_method(name)(*args, **kwargs)
+    raise NotImplementedError(
+        f"torch function {func} is not mapped to the ltorch language "
+        f"(reference analogue: a thunder 'sharp edge')"
+    )

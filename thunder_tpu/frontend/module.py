@@ -1,0 +1,400 @@
+"""ThunderModule: `thunder_tpu.jit(torch.nn.Module)`.
+
+Reference parity: `ThunderModule` (thunder/__init__.py:178) and the
+torch-autograd bridge `ThunderFunction` (thunder/executors/torch_autograd.py:20).
+
+Acquisition (the seat of thunder's bytecode interpreter, see
+frontend/__init__.py): parameters/buffers are swapped for TensorProxies
+directly in each submodule's ``_parameters``/``_buffers`` dicts, the
+original ``forward`` runs under a ``TorchFunctionMode`` that maps every
+torch call to its ltorch symbol, and the recorded trace proceeds through
+the standard pipeline (dce → autodiff split → claiming → XLA staging).
+
+Execution: parameters live as jax arrays on the TPU (converted once via
+DLPack where possible); per call only the *inputs* cross the torch↔jax
+boundary. Backward wires into torch autograd via ``ThunderFunction``:
+saved-for-backward stays on-device as jax arrays on the autograd ctx,
+param grads accumulate onto the torch module's ``.grad`` fields so any
+torch optimizer works unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from thunder_tpu.core.proxies import TensorProxy
+from thunder_tpu.core.pytree import tree_flatten, tree_map
+
+
+def _make_dispatch_mode():
+    """TorchFunctionMode routing torch.* calls to ltorch symbols (factory
+    functions; tensor-position dispatch comes from
+    TensorProxy.__torch_function__, see frontend/dispatch.py)."""
+    from torch.overrides import TorchFunctionMode
+
+    from thunder_tpu.frontend.dispatch import torch_dispatch
+
+    class TorchToLtorch(TorchFunctionMode):
+        def __torch_function__(self, func, types, args=(), kwargs=None):
+            return torch_dispatch(func, types, args, kwargs)
+
+    return TorchToLtorch()
+
+
+def _named_slots(module) -> list[tuple[str, dict, str, Any]]:
+    """(qualified_name, owner_dict, key, tensor) for every param/buffer."""
+    out = []
+    for prefix, sub in module.named_modules():
+        for d in (sub._parameters, sub._buffers):
+            for k, v in list(d.items()):
+                if v is not None:
+                    qual = f"{prefix}.{k}" if prefix else k
+                    out.append((qual, d, k, v))
+    return out
+
+
+class _patched_factories:
+    """Context: torch factory functions (arange/zeros/...) routed to ltorch.
+
+    Factories taking a ``device=`` kwarg fail in torch's C++ argument parser
+    when handed a thunder Device (e.g. HF's
+    ``torch.arange(..., device=input_ids.device)``) — the parse error fires
+    before any __torch_function__ hook can run, so the only interception
+    point is the Python attribute itself.
+    """
+
+    _NAMES = ("arange", "zeros", "ones", "empty", "full", "rand", "randn", "tensor", "linspace")
+    _TORCH_DEVICE_TYPES = (
+        "cpu", "cuda", "xla", "meta", "mps", "xpu", "hpu", "ipu", "mtia", "lazy", "privateuseone",
+    )
+
+    def __enter__(self):
+        import torch
+
+        import thunder_tpu.torch as ttorch
+
+        self._saved = {}
+        for name in self._NAMES:
+            if hasattr(ttorch, name if name != "tensor" else "tensor"):
+                self._saved[name] = getattr(torch, name)
+                setattr(torch, name, getattr(ttorch, name))
+
+        # Device-type query APIs choke on the "tpu" device-type string
+        # (frameworks probe e.g. torch.get_autocast_dtype(x.device.type)).
+        def _mapped(fn):
+            def wrapper(device_type, *a, **kw):
+                if isinstance(device_type, str) and device_type not in self._TORCH_DEVICE_TYPES:
+                    device_type = "cpu"
+                return fn(device_type, *a, **kw)
+
+            return wrapper
+
+        for qname in ("get_autocast_dtype", "is_autocast_enabled"):
+            orig = getattr(torch, qname, None)
+            if orig is not None:
+                self._saved[qname] = orig
+                setattr(torch, qname, _mapped(orig))
+
+        orig_avail = getattr(torch.amp.autocast_mode, "is_autocast_available", None)
+        if orig_avail is not None:
+            self._saved["__amp_avail"] = ("amp", orig_avail)
+            torch.amp.autocast_mode.is_autocast_available = _mapped(orig_avail)
+
+        # torch.autocast(device_type="tpu") → map to cpu (tracing records the
+        # program as written; autocast policy is a trace transform here, not
+        # a torch runtime mode).
+        orig_autocast = torch.autocast
+        known = self._TORCH_DEVICE_TYPES
+
+        class _Autocast(orig_autocast):
+            def __init__(self, device_type, *a, **kw):
+                if isinstance(device_type, str) and device_type not in known:
+                    device_type = "cpu"
+                    kw.setdefault("enabled", False)
+                super().__init__(device_type, *a, **kw)
+
+        self._saved["__autocast"] = ("autocast", orig_autocast)
+        torch.autocast = _Autocast
+        return self
+
+    def __exit__(self, *exc):
+        import torch
+
+        for name, fn in self._saved.items():
+            if name == "__amp_avail":
+                torch.amp.autocast_mode.is_autocast_available = fn[1]
+            elif name == "__autocast":
+                torch.autocast = fn[1]
+            else:
+                setattr(torch, name, fn)
+        return False
+
+
+class _swapped_params:
+    """Context: module params/buffers replaced by ``values[qual_name]``."""
+
+    def __init__(self, module, values: dict):
+        self.module = module
+        self.values = values
+        self._saved: list = []
+
+    def __enter__(self):
+        for qual, d, k, v in _named_slots(self.module):
+            self._saved.append((d, k, v))
+            d[k] = self.values[qual]
+        return self
+
+    def __exit__(self, *exc):
+        for d, k, v in self._saved:
+            d[k] = v
+        self._saved.clear()
+        return False
+
+
+class ThunderModule:
+    """Compiled wrapper around a torch.nn.Module (reference: __init__.py:178)."""
+
+    def __init__(self, module, **jit_options):
+        from thunder_tpu.executors import bridge
+
+        self._module = module
+        self._jit_options = jit_options
+        self._cache: dict[Any, dict] = {}
+
+        self._params: dict[str, Any] = {}  # qual name → jax array
+        self._requires_grad: dict[str, bool] = {}
+        for qual, _, _, t in _named_slots(module):
+            self._params[qual] = bridge.to_jax(t.detach())
+            self._requires_grad[qual] = bool(getattr(t, "requires_grad", False))
+
+    # -- module surface (reference: thunder/__init__.py:246-250) --------------
+
+    def state_dict(self, *args, **kwargs):
+        return self._module.state_dict(*args, **kwargs)
+
+    def load_state_dict(self, *args, **kwargs):
+        r = self._module.load_state_dict(*args, **kwargs)
+        self._resync_params()
+        return r
+
+    def _resync_params(self) -> None:
+        from thunder_tpu.executors import bridge
+
+        for qual, _, _, t in _named_slots(self._module):
+            self._params[qual] = bridge.to_jax(t.detach())
+
+    def named_parameters(self, *a, **kw):
+        return self._module.named_parameters(*a, **kw)
+
+    def parameters(self, *a, **kw):
+        return self._module.parameters(*a, **kw)
+
+    def train(self, mode: bool = True):
+        self._module.train(mode)
+        self._cache.clear()  # dropout etc. change the trace
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    @property
+    def original_module(self):
+        return self._module
+
+    def no_sync(self):
+        from thunder_tpu.distributed import no_sync
+
+        return no_sync()
+
+    # -- compilation ----------------------------------------------------------
+
+    def _compile(self, args: tuple, kwargs: dict) -> dict:
+        import jax
+
+        from thunder_tpu.api import trace_program
+        from thunder_tpu.executors import bridge
+        from thunder_tpu.executors.passes import transform_for_execution
+        from thunder_tpu.extend import resolve_executors
+        from thunder_tpu.transforms.autodiff import forward_and_backward_from_trace
+        from thunder_tpu.transforms.common import dce
+
+        module = self._module
+
+        def functional_fwd(params: dict, *fargs, **fkwargs):
+            with _swapped_params(module, params), _patched_factories(), _make_dispatch_mode():
+                out = module(*fargs, **fkwargs)
+            return _normalize_output(out)
+
+        _, comp = trace_program(functional_fwd, (self._params,) + args, kwargs)
+        comp = dce(comp)
+
+        # Mark requires_grad on the trace's tensor args. Trace args align
+        # with the concrete tensor leaves of ((params, *args), kwargs) in
+        # pytree order; params are jax arrays (no requires_grad of their
+        # own), so the flags come from the torch module / input tensors.
+        flat_concrete, _ = tree_flatten(((self._params,) + args, kwargs))
+        concrete_tensors = [x for x in flat_concrete if bridge.is_concrete_tensor(x)]
+        name_of = {id(v): n for n, v in self._params.items()}
+        wrt_kinds: list[tuple[str, Any]] = []  # ("input", pos) | ("param", qual)
+        input_pos = 0
+        for proxy_arg, conc in zip(comp.args, concrete_tensors):
+            qual = name_of.get(id(conc))
+            if qual is not None:
+                rg = self._requires_grad[qual]
+            else:
+                rg = bool(getattr(conc, "requires_grad", False))
+                input_pos += 1
+            from thunder_tpu.core import dtypes as _dt
+
+            rg = rg and _dt.is_inexact_dtype(proxy_arg.dtype)
+            proxy_arg._requires_grad = rg
+            if rg:
+                wrt_kinds.append(("param", qual) if qual is not None else ("input", input_pos - 1))
+
+        executors = resolve_executors(self._jit_options.get("executors"))
+        needs_grad = any(a.requires_grad for a in comp.args if isinstance(a, TensorProxy))
+
+        if not needs_grad:
+            ex = transform_for_execution(comp, executors)
+            return {"fwd": jax.jit(ex.python_callable()), "bwd": None, "traces": [comp, ex]}
+
+        fw, bw = forward_and_backward_from_trace(comp)
+        fw_ex = transform_for_execution(fw, executors)
+        bw_ex = transform_for_execution(bw, executors)
+        return {
+            "fwd": jax.jit(fw_ex.python_callable()),
+            "bwd": jax.jit(bw_ex.python_callable()),
+            "wrt_kinds": wrt_kinds,
+            "traces": [comp, fw_ex, bw_ex],
+        }
+
+    def _cache_key(self, args: tuple, kwargs: dict):
+        from thunder_tpu.executors import bridge
+
+        def leaf_key(x):
+            if bridge.is_concrete_tensor(x):
+                shape, dev, dt, rg = bridge.tensor_metadata(x)
+                return (tuple(shape), dev.split(":")[0], str(dt), rg)
+            return x if isinstance(x, (int, float, bool, str, type(None))) else type(x).__name__
+
+        flat, spec = tree_flatten((args, kwargs))
+        return (tuple(leaf_key(x) for x in flat), str(spec))
+
+    # -- call -----------------------------------------------------------------
+
+    def __call__(self, *args, **kwargs):
+        from thunder_tpu.executors import bridge
+
+        key = self._cache_key(args, kwargs)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._compile(args, kwargs)
+            self._cache[key] = entry
+
+        flat_concrete, _ = tree_flatten(((self._params,) + args, kwargs))
+        flat_inputs = [bridge.to_jax(x) if bridge.is_concrete_tensor(x) else x for x in flat_concrete]
+
+        if entry["bwd"] is None:
+            return _to_torch_tree(entry["fwd"](*flat_inputs))
+
+        input_tensors = [
+            x for x in flat_concrete
+            if bridge.is_torch_tensor(x) and getattr(x, "requires_grad", False)
+        ]
+        param_of = {qual: None for kind, qual in entry["wrt_kinds"] if kind == "param"}
+        named = dict(_named_qual_tensors(self._module))
+        for qual in param_of:
+            param_of[qual] = named.get(qual)
+
+        return _run_thunder_function(entry, flat_inputs, input_tensors, param_of)
+
+
+def _named_qual_tensors(module):
+    for qual, _, _, t in _named_slots(module):
+        yield qual, t
+
+
+def _run_thunder_function(entry: dict, flat_inputs: list, input_tensors: list, param_of: dict):
+    import torch
+
+    from thunder_tpu.executors import bridge
+
+    import jax
+
+    holder: dict = {}
+
+    class ThunderFunction(torch.autograd.Function):
+        """Reference parity: thunder/executors/torch_autograd.py:20.
+
+        autograd.Function outputs must be a flat tuple of tensors, so the
+        output pytree is flattened here and rebuilt by the caller."""
+
+        @staticmethod
+        def forward(ctx, _anchor, *grad_sources):
+            out, saved = entry["fwd"](*flat_inputs)
+            ctx.thunder_saved = saved
+            flat, spec = tree_flatten(out)
+            tensor_pos = [i for i, x in enumerate(flat) if isinstance(x, jax.Array)]
+            holder.update(flat=flat, spec=spec, pos=tensor_pos)
+            return tuple(_to_torch_tree(flat[i]) for i in tensor_pos)
+
+        @staticmethod
+        def backward(ctx, *cotangents):
+            cts = [bridge.to_jax(c) for c in cotangents]
+            grads = entry["bwd"](*ctx.thunder_saved, *cts)
+            ctx.thunder_saved = None  # free eagerly (reference: :69-74)
+            out_grads = []
+            for (kind, which), g in zip(entry["wrt_kinds"], grads):
+                if kind == "input":
+                    out_grads.append((which, bridge.to_torch(g)))
+                else:
+                    owner = param_of.get(which)
+                    if owner is not None:
+                        tg = bridge.to_torch(g).to(owner.dtype)
+                        owner.grad = tg if owner.grad is None else owner.grad + tg
+            result = [None] * len(input_tensors)
+            for pos, g in out_grads:
+                result[pos] = g
+            return (None,) + tuple(result)
+
+    # The anchor keeps the autograd graph alive when all differentiable
+    # leaves are device-side params (module params live as jax arrays, so
+    # torch would otherwise see a function with no grad-requiring inputs).
+    anchor = torch.empty(0, requires_grad=True)
+    out_tensors = ThunderFunction.apply(anchor, *input_tensors)
+    if not isinstance(out_tensors, tuple):
+        out_tensors = (out_tensors,)
+    flat = list(holder["flat"])
+    for i, t in zip(holder["pos"], out_tensors):
+        flat[i] = t
+    from thunder_tpu.core.pytree import tree_unflatten
+
+    return tree_unflatten(holder["spec"], flat)
+
+
+def _normalize_output(out):
+    """Convert dataclass-style outputs (HF ModelOutput: an OrderedDict
+    subclass jax's pytree treats as a leaf) into a plain dict of traceable
+    entries; opaque stateful objects (KV caches) are dropped."""
+    if type(out) in (dict, tuple, list) or isinstance(out, TensorProxy):
+        return out
+    if hasattr(out, "items") and hasattr(out, "to_tuple"):  # ModelOutput duck-type
+        kept = {}
+        for k, v in out.items():
+            flat, _ = tree_flatten(v)
+            if all(isinstance(x, TensorProxy) or x is None or isinstance(x, (int, float, bool)) for x in flat):
+                kept[k] = v
+        return kept
+    return out
+
+
+def _to_torch_tree(out):
+    import jax
+
+    from thunder_tpu.executors import bridge
+
+    return tree_map(lambda x: bridge.to_torch(x) if isinstance(x, jax.Array) else x, out)
+
+
+def thunder_module(module, **jit_options) -> ThunderModule:
+    return ThunderModule(module, **jit_options)

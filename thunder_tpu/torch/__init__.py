@@ -297,6 +297,12 @@ def flatten(a, start_dim: int = 0, end_dim: int = -1):
 
 @torchsymbol("torch.cat", "torch.concat")
 def cat(tensors, dim: int = 0):
+    # torch's legacy allowance: 1-D zero-element tensors are compatible with
+    # anything in cat and contribute nothing (HF KV caches rely on this).
+    tensors = [t for t in tensors if not (t.ndim == 1 and t.numel == 0)]
+    check(len(tensors) > 0, "cat of only empty tensors")
+    if len(tensors) == 1:
+        return prims.shallow_copy(tensors[0])
     return clang.cat(list(tensors), int(pyval(dim)))
 
 
