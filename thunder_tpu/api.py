@@ -343,6 +343,16 @@ def jit(
 
     _ensure_runtime()
 
+    # autocast option → a trace transform running before grad/claiming
+    # (reference: thunder/__init__.py:543 applies autocast pre-split).
+    ac = compile_options.pop("autocast", None)
+    if ac:
+        from thunder_tpu.transforms.autocast import autocast as _ac_transform
+
+        ac_dtype = dtypes.to_dtype(ac) if not isinstance(ac, bool) else dtypes.bfloat16
+        tts = tuple(compile_options.get("_trace_transforms", ()))
+        compile_options["_trace_transforms"] = (lambda trc: _ac_transform(trc, ac_dtype),) + tts
+
     # torch nn.Module → ThunderModule wrapper (the torch frontend).
     _torch = None
     try:

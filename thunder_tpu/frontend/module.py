@@ -259,6 +259,10 @@ class ThunderModule:
             return {"fwd": jax.jit(ex.python_callable()), "bwd": None, "traces": [comp, ex]}
 
         fw, bw = forward_and_backward_from_trace(comp)
+        if self._jit_options.get("rematerialize", True):
+            from thunder_tpu.transforms.rematerialization import rematerialize_forward_and_backward
+
+            fw, bw = rematerialize_forward_and_backward(fw, bw)
         fw_ex = transform_for_execution(fw, executors)
         bw_ex = transform_for_execution(bw, executors)
         return {
