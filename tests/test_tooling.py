@@ -1,0 +1,131 @@
+"""Tooling: examine, memory estimator, benchmark harness, checkpointing,
+trace dump (reference: thunder/examine tests + benchmark harness usage)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import thunder_tpu
+import thunder_tpu.torch as ttorch
+from thunder_tpu.api import trace_program
+from thunder_tpu.transforms.common import dce
+
+
+def _t(*shape, seed=0):
+    rng = np.random.RandomState(seed + sum(shape))
+    return rng.randn(*shape).astype(np.float32)
+
+
+class TestExamine:
+    def test_examine_supported(self):
+        from thunder_tpu.examine import examine
+
+        report = examine(lambda x: ttorch.sum(ttorch.gelu(x)), _t(4, 8))
+        assert report["supported"]
+        assert report["trace"] is not None
+
+    def test_get_fusions(self):
+        from thunder_tpu.examine import get_fusions
+
+        def f(l, t):
+            return ttorch.cross_entropy(l, t)
+
+        logits = _t(16, 128)
+        target = np.zeros((16,), dtype=np.int64)
+        jf = thunder_tpu.jit(f)
+        jf(logits, target)
+        fusions = get_fusions(thunder_tpu.last_traces(jf)[-1])
+        names = {ex for ex, _ in fusions}
+        assert "pallas" in names or "jax" in names
+
+    def test_memory_estimator(self):
+        from thunder_tpu.examine import get_alloc_memory
+
+        def f(x, w):
+            h = ttorch.linear(x, w)  # (128, 256): 128*256*4 = 131072 B
+            return ttorch.sum(h)
+
+        x, w = _t(128, 64), _t(256, 64, seed=1)
+        _, comp = trace_program(f, (x, w), {})
+        from thunder_tpu.executors.passes import del_last_used, transform_for_execution
+        from thunder_tpu.extend import resolve_executors
+
+        ex = del_last_used(transform_for_execution(dce(comp), resolve_executors(["jax"])))
+        peak, timeline = get_alloc_memory(ex)
+        inputs_bytes = x.nbytes + w.nbytes
+        assert peak >= inputs_bytes + 128 * 256 * 4
+        assert peak < inputs_bytes + 2 * 128 * 256 * 4 + 4096
+
+
+class TestBenchmarkHarness:
+    def test_run_benchmark(self):
+        import jax.numpy as jnp
+
+        from thunder_tpu.benchmarks import run_benchmark
+
+        x = jnp.ones((128, 128))
+        r = run_benchmark("matmul", lambda: x @ x, warmup=1, iters=3,
+                          tokens_per_iter=128, flops_per_iter=2 * 128**3)
+        s = r.summary()
+        assert s["iters"] == 3 and s["median_iter_time_s"] > 0
+        assert "tokens_per_sec" in s and "mfu" in s
+
+    def test_litgpt_cli(self):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, "-m", "thunder_tpu.benchmarks.litgpt",
+             "--model", "gpt-tiny", "--micro-batch", "2", "--seq", "32",
+             "--iters", "2", "--warmup", "1"],
+            capture_output=True, text=True, timeout=420, env=env,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        summary = json.loads(r.stdout.strip().splitlines()[-1])
+        assert summary["tokens_per_sec"] > 0
+        assert summary["n_params"] > 0
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        from thunder_tpu.core import dtypes
+        from thunder_tpu.distributed.checkpoint import load, save
+        from thunder_tpu.models import gpt as m
+
+        cfg = m.name_to_config("gpt-tiny")
+        params = m.init_params(cfg, dtype=dtypes.float32, seed=3)
+        path = str(tmp_path / "ckpt")
+        save(params, path)
+        restored = load(path)
+        from thunder_tpu.core.pytree import tree_flatten
+
+        a, s1 = tree_flatten(params)
+        b, s2 = tree_flatten(restored)
+        assert s1 == s2
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestTraceDump:
+    def test_execution_callback_file(self, tmp_path):
+        path = str(tmp_path / "trace.py")
+        thunder_tpu.set_execution_callback_file(path)
+        try:
+            jf = thunder_tpu.jit(lambda x: ttorch.sum(x * 2.0))
+            jf(_t(4, 4))
+        finally:
+            thunder_tpu.set_execution_callback_file(None)
+        src = open(path).read()
+        assert "def computation" in src and "mul" in src
+
+
+class TestCompileStats:
+    def test_timers_populated(self):
+        jf = thunder_tpu.jit(lambda x: ttorch.sum(x))
+        jf(_t(4, 4))
+        cs = thunder_tpu.compile_stats(jf)
+        assert cs.cache_misses == 1
+        assert cs.last_trace_tracing_stop >= cs.last_trace_tracing_start > 0

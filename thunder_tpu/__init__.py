@@ -28,5 +28,6 @@ from thunder_tpu.api import (  # noqa: F401
     last_compile_options,
     cache_hits,
     cache_misses,
+    set_execution_callback_file,
 )
 

@@ -240,6 +240,7 @@ def _compile_entry(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict)
     plg_ex = transform_for_execution(plg_trc, (get_executor("python"),))
     plg_traces.append(plg_ex)
 
+    _maybe_dump_trace(extrace)
     prologue_fn = plg_ex.python_callable()
     trace_callable = extrace.python_callable()
 
@@ -269,6 +270,24 @@ def _compile_entry(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict)
     if cd.cache_option is not CACHE_OPTIONS.NO_CACHING:
         cs.cache_entries.append(entry)
     return entry
+
+
+# Trace-dump-and-edit hook (reference: thunder/__init__.py:168-170 +
+# trace.py:400-415 — write the final program to a file so a human can read
+# or edit it; the canonical debugging tool is reading the generated Python).
+_execution_callback_file = {"path": None}
+
+
+def set_execution_callback_file(path: Optional[str]) -> None:
+    _execution_callback_file["path"] = path
+
+
+def _maybe_dump_trace(trc: TraceCtx) -> None:
+    path = _execution_callback_file["path"]
+    if path:
+        with open(path, "a") as f:
+            f.write(trc.python())
+            f.write("\n\n")
 
 
 _global_rng = {"seed": 0}

@@ -1,0 +1,163 @@
+"""Benchmark harness: timing, throughput, and MFU statistics.
+
+Reference parity: thunder/benchmarks/__init__.py (`Benchmark:72`, timing
+machinery `_benchmark:238`) and the LitGPT end-to-end metrics of
+benchmark_litgpt.py:348-367 — `average_iter_time`, `tokens_per_sec`
+(= global_batch × seq_len / iter_time), `model_flop_per_sec` (→ MFU against
+chip peak), `memory_used_GB`.
+
+TPU notes: timing forces completion with a scalar device→host read (async
+dispatch otherwise returns immediately, see bench.py), and peak memory
+comes from the device's allocator stats where exposed.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+TPU_PEAK_BF16_TFLOPS = {"v5e": 197.0, "v5p": 459.0, "v4": 275.0, "v6e": 918.0}
+
+
+def tpu_generation() -> str:
+    import os
+
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    if gen:
+        return gen
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+        for g in ("v6e", "v5p", "v5e", "v4"):
+            if g in kind.replace(" ", ""):
+                return g
+        if "v5 lite" in kind or "v5lite" in kind:
+            return "v5e"
+    except Exception:
+        pass
+    return "v5e"
+
+
+def peak_tflops() -> float:
+    return TPU_PEAK_BF16_TFLOPS.get(tpu_generation(), 197.0)
+
+
+def device_memory_used_gb() -> Optional[float]:
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        return stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0)) / 1e9
+    except Exception:
+        return None
+
+
+def force_completion(out) -> float:
+    """Force device completion via a scalar host read; returns the scalar."""
+    import jax
+
+    from thunder_tpu.core.pytree import tree_leaves
+
+    for leaf in reversed(tree_leaves(out)):
+        if isinstance(leaf, jax.Array):
+            flat = leaf.reshape(-1) if leaf.ndim else leaf
+            return float(np.asarray(flat[0] if leaf.ndim else flat))
+    return 0.0
+
+
+@dataclass
+class BenchmarkResult:
+    name: str
+    iters: int
+    times_s: list[float]
+    tokens_per_iter: Optional[int] = None
+    flops_per_iter: Optional[float] = None
+    memory_gb: Optional[float] = None
+
+    @property
+    def median_s(self) -> float:
+        return statistics.median(self.times_s)
+
+    @property
+    def mean_s(self) -> float:
+        return statistics.fmean(self.times_s)
+
+    @property
+    def stdev_s(self) -> float:
+        return statistics.stdev(self.times_s) if len(self.times_s) > 1 else 0.0
+
+    @property
+    def tokens_per_sec(self) -> Optional[float]:
+        return self.tokens_per_iter / self.median_s if self.tokens_per_iter else None
+
+    @property
+    def tflops_per_sec(self) -> Optional[float]:
+        return self.flops_per_iter / self.median_s / 1e12 if self.flops_per_iter else None
+
+    @property
+    def mfu(self) -> Optional[float]:
+        t = self.tflops_per_sec
+        return t / peak_tflops() if t else None
+
+    def summary(self) -> dict:
+        d = {
+            "name": self.name,
+            "iters": self.iters,
+            "average_iter_time_s": round(self.mean_s, 5),
+            "median_iter_time_s": round(self.median_s, 5),
+            "stdev_s": round(self.stdev_s, 6),
+        }
+        if self.tokens_per_sec:
+            d["tokens_per_sec"] = round(self.tokens_per_sec)
+        if self.tflops_per_sec:
+            d["model_tflop_per_sec"] = round(self.tflops_per_sec, 2)
+            d["mfu"] = round(self.mfu, 4)
+        if self.memory_gb is not None:
+            d["memory_used_GB"] = round(self.memory_gb, 2)
+        return d
+
+
+def run_benchmark(
+    name: str,
+    fn: Callable[[], Any],
+    *,
+    warmup: int = 2,
+    iters: int = 5,
+    tokens_per_iter: Optional[int] = None,
+    flops_per_iter: Optional[float] = None,
+) -> BenchmarkResult:
+    for _ in range(warmup):
+        force_completion(fn())
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        force_completion(fn())
+        times.append(time.perf_counter() - t0)
+    return BenchmarkResult(
+        name=name,
+        iters=iters,
+        times_s=times,
+        tokens_per_iter=tokens_per_iter,
+        flops_per_iter=flops_per_iter,
+        memory_gb=device_memory_used_gb(),
+    )
+
+
+def training_flops_per_token(n_params: float) -> float:
+    """fwd+bwd ≈ 6·N FLOPs/token (fwd 2N, bwd 4N)."""
+    return 6.0 * n_params
+
+
+def forward_flops_per_token(n_params: float) -> float:
+    return 2.0 * n_params
+
+
+def count_params(params) -> int:
+    from thunder_tpu.core.pytree import tree_leaves
+
+    return sum(int(np.prod(p.shape)) for p in tree_leaves(params) if hasattr(p, "shape"))

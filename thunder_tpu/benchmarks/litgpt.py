@@ -1,0 +1,108 @@
+"""LitGPT-style end-to-end training benchmark CLI.
+
+Reference parity: thunder/benchmarks/benchmark_litgpt.py:41 — model-name ×
+batch × seq × distributed-config training benchmark reporting iteration
+time, tokens/sec, TFLOP/s → MFU, and peak memory.
+
+Usage:
+    python -m thunder_tpu.benchmarks.litgpt --model pythia-160m \
+        --micro-batch 4 --seq 1024 --iters 10 [--fsdp 8] [--tp 2] [--dp 2] \
+        [--forward-only] [--dtype bfloat16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="pythia-160m")
+    p.add_argument("--micro-batch", type=int, default=4)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--forward-only", action="store_true")
+    args = p.parse_args()
+
+    from thunder_tpu.benchmarks import (
+        count_params,
+        forward_flops_per_token,
+        run_benchmark,
+        training_flops_per_token,
+    )
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.models import gpt as m
+
+    cfg = m.name_to_config(args.model)
+    seq = min(args.seq, cfg.block_size)
+    params = m.init_params(cfg, dtype=dtypes.to_dtype(args.dtype), device_init=True, seed=0)
+    n_params = count_params(params)
+
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, cfg.vocab_size, (args.micro_batch, seq)).astype(np.int32)
+    tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+    tokens = args.micro_batch * seq
+
+    mesh = None
+    if args.dp * args.fsdp * args.tp > 1:
+        from thunder_tpu.parallel import make_mesh
+        from thunder_tpu.parallel.sharding import gpt_param_specs, shard_pytree
+
+        mesh = make_mesh(dp=args.dp, fsdp=args.fsdp, tp=args.tp)
+        specs = gpt_param_specs(cfg, mesh)
+        params = shard_pytree(params, mesh, specs)
+
+    if args.forward_only:
+        import jax
+
+        from thunder_tpu.api import trace_program
+        from thunder_tpu.core.pytree import tree_flatten
+        from thunder_tpu.executors.passes import transform_for_execution
+        from thunder_tpu.extend import resolve_executors
+        from thunder_tpu.transforms.common import dce
+
+        fn = lambda p, i: m.forward(p, i, cfg)  # noqa: E731
+        _, comp = trace_program(fn, (params, idx), {})
+        ex = transform_for_execution(dce(comp), resolve_executors(None))
+        jfn = jax.jit(ex.python_callable())
+        flat, _ = tree_flatten(((params, idx), {}))
+        result = run_benchmark(
+            f"{args.model}-fwd", lambda: jfn(*flat), warmup=args.warmup, iters=args.iters,
+            tokens_per_iter=tokens, flops_per_iter=forward_flops_per_token(n_params) * tokens,
+        )
+    else:
+        from thunder_tpu.parallel import build_train_step
+        from thunder_tpu.parallel.sharding import gpt_param_specs
+
+        specs = gpt_param_specs(cfg, mesh) if mesh is not None else None
+        step, opt = build_train_step(
+            cfg, params, idx, tgt, mesh=mesh, param_specs=specs, lr=args.lr, donate=False
+        )
+        state = {"params": params, "opt": opt}
+
+        def one_step():
+            state["params"], state["opt"], loss = step(state["params"], state["opt"], idx, tgt)
+            return loss
+
+        result = run_benchmark(
+            f"{args.model}-train", one_step, warmup=args.warmup, iters=args.iters,
+            tokens_per_iter=tokens, flops_per_iter=training_flops_per_token(n_params) * tokens,
+        )
+
+    summary = result.summary()
+    summary["n_params"] = n_params
+    summary["mesh"] = {"dp": args.dp, "fsdp": args.fsdp, "tp": args.tp}
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Examine: support reporting, trace inspection, static memory estimation.
+
+Reference parity: thunder/examine/__init__.py (`examine:49` — reports which
+torch ops in a callable are unsupported; `get_fusions:190`) and
+examine/memory_caculation.py (`get_alloc_memory:120` — static peak-memory
+estimate over a trace).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from thunder_tpu.core.prims import OpTags, PrimIDs
+from thunder_tpu.core.proxies import TensorProxy, variableify
+from thunder_tpu.core.pytree import tree_flatten
+from thunder_tpu.core.trace import TraceCtx
+
+
+def examine(fn: Callable, *args, **kwargs) -> dict:
+    """Report whether ``fn`` can be traced, and which torch operations are
+    not supported (reference: examine/__init__.py:49 — there via a
+    TorchFunctionMode collector; here by running the acquisition itself and
+    collecting dispatch failures)."""
+    import torch
+
+    from thunder_tpu.frontend.module import ThunderModule
+    from thunder_tpu.api import trace_program
+
+    unsupported: list[str] = []
+    report: dict[str, Any] = {"supported": False, "unsupported_ops": unsupported, "trace": None}
+
+    try:
+        if isinstance(fn, torch.nn.Module):
+            tm = ThunderModule(fn)
+            _, comp = tm._trace_forward_for_examine(args, kwargs) if hasattr(
+                tm, "_trace_forward_for_examine"
+            ) else (None, None)
+            if comp is None:
+                entry = tm._compile(args, kwargs)
+                comp = entry["traces"][0]
+        else:
+            _, comp = trace_program(fn, args, kwargs)
+        report["supported"] = True
+        report["trace"] = comp
+    except NotImplementedError as e:
+        unsupported.append(str(e))
+    except Exception as e:  # noqa: BLE001
+        report["error"] = f"{type(e).__name__}: {e}"
+    return report
+
+
+def get_fusions(trace: TraceCtx) -> list[tuple[str, Any]]:
+    """Executor-claimed regions of a trace (reference: examine:190). Under
+    whole-trace XLA staging every claimed bsym is one 'fusion seed'; returns
+    (executor_name, bsym) pairs for non-default executors."""
+    out = []
+    for bsym in trace.bound_symbols:
+        ex = bsym.sym.executor
+        if ex is not None and ex.name not in ("python",):
+            out.append((ex.name, bsym))
+    return out
+
+
+_DEL_IDS = {PrimIDs.DEL}
+_NO_ALLOC_IDS = {
+    PrimIDs.RETURN, PrimIDs.COMMENT, PrimIDs.PRINT,
+    PrimIDs.UNPACK_TRIVIAL, PrimIDs.UNPACK_SEQUENCE, PrimIDs.UNPACK_KEY, PrimIDs.UNPACK_ATTR,
+    PrimIDs.CHECK_TENSOR_SHAPE_AND_METADATA, PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE,
+    PrimIDs.CHECK_STRING_VALUE, PrimIDs.CHECK_LEN, PrimIDs.CHECK_NONE,
+    PrimIDs.SHALLOW_COPY, PrimIDs.STOP_GRADIENT,
+}
+
+
+def get_alloc_memory(trace: TraceCtx) -> tuple[int, dict[str, int]]:
+    """Static peak-allocation estimate over a trace in bytes
+    (reference: examine/memory_caculation.py:120).
+
+    Walks the program keeping a live-set of tensor buffers: inputs are live
+    at entry, outputs of each bsym allocate, and ``del`` frees. Aliasing
+    ops (shallow_copy/stop_gradient/views) are counted as allocations only
+    when XLA would materialize them (reshape/transpose are not charged).
+    """
+    live: dict[str, int] = {}
+    flat_args, _ = tree_flatten((trace.args, trace.kwargs))
+    for a in flat_args:
+        if isinstance(a, TensorProxy):
+            live[a.name] = a.size_bytes
+
+    peak = sum(live.values())
+    timeline: dict[str, int] = {"inputs": peak}
+
+    for i, bsym in enumerate(trace.bound_symbols):
+        if bsym.sym.id in _DEL_IDS:
+            for p in bsym.flat_proxy_args:
+                live.pop(p.name, None)
+            continue
+        if bsym.sym.id in _NO_ALLOC_IDS:
+            continue
+        for o in bsym.flat_proxy_outs:
+            if isinstance(o, TensorProxy) and o.name not in live:
+                live[o.name] = o.size_bytes
+        cur = sum(live.values())
+        if cur > peak:
+            peak = cur
+            timeline[f"{i}:{bsym.sym.name}"] = cur
+    return peak, timeline
