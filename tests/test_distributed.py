@@ -142,3 +142,17 @@ class TestMultiDevice:
 
     def test_fsdp_api(self):
         _run_scenario("fsdp_api")
+
+
+class TestSequenceParallel:
+    """Long-context parallelism — ring + Ulysses attention over the sp axis
+    (an extension beyond the reference, which has none: SURVEY.md §5)."""
+
+    def test_ring_attention(self):
+        _run_scenario("ring_attention")
+
+    def test_ulysses_attention(self):
+        _run_scenario("ulysses_attention")
+
+    def test_long_context_train(self):
+        _run_scenario("long_context_train")

@@ -1,0 +1,145 @@
+"""Sequence/context parallelism: ring attention and Ulysses (all-to-all).
+
+The reference has **no** long-context support (SURVEY.md §5: no ring
+attention, no sequence parallelism anywhere in the tree) — these are
+first-class here because the TPU torus makes them natural:
+
+- **Ring attention** (`ring_attention`): K/V shards rotate around the
+  ``sp`` ring via `ppermute` while each device accumulates online-softmax
+  partial results for its local Q block. Peak memory per device is
+  O(S_local²) scores instead of O(S²); ICI neighbour hops overlap with the
+  per-block matmuls under XLA's scheduler. Written in pure differentiable
+  jax (ppermute has a transpose rule), so jax.grad/our VJP-of-executor
+  path both work.
+- **Ulysses** (`ulysses_attention`): all-to-all reshards (seq-sharded →
+  head-sharded), runs dense/flash attention on full sequences per head
+  group, and reshards back — two all-to-alls per attention instead of a
+  ring of p2p steps; better when heads ≥ sp and ICI all-to-all bandwidth
+  is plentiful.
+
+Both run inside ``shard_map`` over a mesh ``sp`` axis (see
+tests/_dist_worker.py scenarios for the 8-device CPU-mesh proofs).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+
+def _block_attn(q, k, v, *, scale, q_offset, k_offset, causal):
+    """One (S_q_local, S_k_local) attention block with global-position causal
+    masking. Returns (o_unnormalized, m, l) for online-softmax merging."""
+    import jax
+    import jax.numpy as jnp
+
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if causal:
+        Sq, Sk = q.shape[-2], k.shape[-2]
+        qpos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+        kpos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+        s = jnp.where(qpos >= kpos, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)  # (B,H,Sq,1)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)  # fully-masked rows
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+    return o, m_safe, l
+
+
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = True, scale: Optional[float] = None):
+    """Causal attention with sequence sharded over the mesh axis
+    ``axis_name``. q/k/v: (B, H, S_local, D) per device; output matches q.
+
+    K/V rotate one ring hop per step; each device merges the incoming
+    block's contribution with the running (out, max, denom) accumulator —
+    the blockwise/online-softmax formulation of flash attention lifted to
+    the device ring.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    S_local = q.shape[-2]
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    q_offset = my * S_local
+    perm = None  # built lazily from the static axis size
+
+    o_acc = jnp.zeros(q.shape[:-1] + (D,), dtype=jnp.float32)
+    m_acc = jnp.full(q.shape[:-1] + (1,), -jnp.inf, dtype=jnp.float32)
+    l_acc = jnp.zeros(q.shape[:-1] + (1,), dtype=jnp.float32)
+
+    k_cur, v_cur = k, v
+    # The axis size is static under shard_map, so a Python loop unrolls into
+    # n pipeline stages XLA can overlap (ppermute_i+1 with block-matmul_i).
+    n_static = int(n) if not hasattr(n, "aval") else None
+    if n_static is None:
+        raise ValueError("ring_attention requires a static mesh axis size")
+
+    for step in range(n_static):
+        src = (my - step) % n  # which global block k_cur/v_cur hold
+        k_offset = src * S_local
+        o, m, l = _block_attn(q, k_cur, v_cur, scale=scale, q_offset=q_offset,
+                              k_offset=k_offset, causal=causal)
+        # online-softmax merge
+        m_new = jnp.maximum(m_acc, m)
+        alpha = jnp.exp(m_acc - m_new)  # rescale old accumulator
+        beta = jnp.exp(m - m_new)  # rescale new block
+        o_acc = o_acc * alpha + o * beta
+        l_acc = l_acc * alpha + l * beta
+        m_acc = m_new
+        if step + 1 < n_static:
+            ring = [(i, (i + 1) % n_static) for i in range(n_static)]
+            k_cur = lax.ppermute(k_cur, axis_name, ring)
+            v_cur = lax.ppermute(v_cur, axis_name, ring)
+
+    out = o_acc / jnp.maximum(l_acc, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = True, scale: Optional[float] = None):
+    """DeepSpeed-Ulysses-style sequence parallelism: all-to-all from
+    seq-sharded (B, H, S/p, D) to head-sharded (B, H/p, S, D), dense/flash
+    attention over the full sequence, then all-to-all back. Requires
+    H % axis_size == 0."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = int(lax.psum(1, axis_name)) if not hasattr(lax.psum(1, axis_name), "aval") else None
+    # axis size is static inside shard_map
+    n = n if n is not None else 1
+    B, H, S_local, D = q.shape
+    assert H % n == 0, f"heads {H} must divide sp axis {n}"
+
+    def to_head_sharded(x):
+        # (B, H, S/p, D) → (B, H/p, S, D). With tiled=False, all_to_all
+        # removes the split axis and inserts a source-device axis at the
+        # concat position — the device axis IS the seq-block index.
+        x = x.reshape(B, n, H // n, S_local, D)
+        x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3, tiled=False)
+        # (B, H//n, S_local, n, D); seq order must be block-major:
+        x = jnp.swapaxes(x, 2, 3)  # (B, H//n, n, S_local, D)
+        return x.reshape(B, H // n, n * S_local, D)
+
+    def to_seq_sharded(x):
+        # (B, H/p, S, D) → (B, H, S/p, D); inverse of the above.
+        x = x.reshape(B, H // n, n, S_local, D)
+        x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=False)
+        # (B, n, H//n, S_local, D); head order is group-major:
+        return x.reshape(B, H, S_local, D)
+
+    qh, kh, vh = to_head_sharded(q), to_head_sharded(k), to_head_sharded(v)
+    o, _, l = _block_attn(qh, kh, vh, scale=scale if scale is not None else 1.0 / math.sqrt(D),
+                          q_offset=0, k_offset=0, causal=causal)
+    o = (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    return to_seq_sharded(o)
