@@ -104,22 +104,66 @@ def rematerialize_forward_and_backward(fw_trace: TraceCtx, bw_trace: TraceCtx):
             p = next((o for o in b.flat_proxy_outs if o.name == name), None) if b else None
         return p.size_bytes if isinstance(p, TensorProxy) else 0
 
+    def closure_until(name: str, stops: set[str]):
+        """Recompute chain for ``name`` walking cheap producers, stopping at
+        ``stops``/args. Returns (chain, frontier) or None if blocked."""
+        chain: list = []
+        frontier: set[str] = set()
+        visiting: set[str] = set()
+
+        def walk(n: str) -> bool:
+            if n in stops or n in arg_proxies:
+                frontier.add(n)
+                return True
+            if n in visiting:
+                return True
+            visiting.add(n)
+            b = producers.get(n)
+            if b is None or not _is_cheap(b):
+                return False
+            for a in b.flat_proxy_args:
+                if not walk(a.name):
+                    return False
+            if b not in chain:
+                chain.append(b)
+            return True
+
+        return (chain, frontier) if walk(name) else None
+
     keep: list[str] = []
     recompute: dict[str, tuple] = {}
-    for name in saved_names:
-        c = closure(name)
-        if c is None or not c[0]:
-            keep.append(name)
-            continue
-        chain, frontier = c
-        # Frontier tensors not already saved/args become extra saved values:
-        # recompute only if it's a net win in bytes.
-        extra = [f for f in frontier if f not in saved_names and f not in arg_proxies and f not in keep]
-        extra_bytes = sum(size_of(f) for f in extra)
-        if extra_bytes >= size_of(name):
-            keep.append(name)
-            continue
-        recompute[name] = (chain, frontier)
+    cut_set = _min_cut_saved_set(saved_names, producers, arg_proxies, closure, size_of)
+
+    if cut_set is not None:
+        # Min-cut chose the optimal save boundary (possibly mid-chain).
+        stops = set(cut_set)
+        for name in saved_names:
+            if name in cut_set or name in arg_proxies:
+                if name not in keep:
+                    keep.append(name)
+                continue
+            c = closure_until(name, stops)
+            if c is None or not c[0]:
+                keep.append(name)
+            else:
+                recompute[name] = c
+        # Cut nodes that aren't original saved values become new saved values
+        # via the recompute frontiers (handled below).
+    else:
+        for name in saved_names:
+            c = closure(name)
+            if c is None or not c[0]:
+                keep.append(name)
+                continue
+            chain, frontier = c
+            # Greedy fallback: frontier tensors not already saved/args become
+            # extra saved values; recompute only if it's a net win in bytes.
+            extra = [f for f in frontier if f not in saved_names and f not in arg_proxies and f not in keep]
+            extra_bytes = sum(size_of(f) for f in extra)
+            if extra_bytes >= size_of(name):
+                keep.append(name)
+                continue
+            recompute[name] = (chain, frontier)
 
     if not recompute:
         return fw_trace, bw_trace
@@ -184,6 +228,80 @@ def rematerialize_forward_and_backward(fw_trace: TraceCtx, bw_trace: TraceCtx):
     new_fw = wrap_in_trace_provenance(new_fw, "Rematerialization (fw)", start)
     new_bw = wrap_in_trace_provenance(new_bw, "Rematerialization (bw)", start)
     return new_fw, new_bw
+
+
+def _min_cut_saved_set(saved_names, producers, arg_proxies, closure, size_of):
+    """Optimal save boundary via s-t min cut (reference:
+    rematerialization.py:245 — igraph max-flow; here the in-repo C++ Dinic,
+    thunder_tpu/csrc/mincut.cpp, with a Python fallback).
+
+    Node-split graph over the cheap recompute region:
+      S → seed_in (∞) for every available value (fw arg / expensive output),
+      v_in → v_out (bytes(v)) for every region proxy — cutting = saving v,
+      x_out → w_in (∞) along cheap dataflow,
+      v_out → T (∞) for every currently-saved value.
+    The min cut is the cheapest set of proxies that separates availability
+    from the backward's needs; everything on the sink side recomputes.
+    Returns the save set (names), or None when the region is trivial.
+    """
+    try:
+        from thunder_tpu.transforms.mincut import INF_CAP, min_cut
+    except Exception:
+        return None
+
+    # Region discovery: union of all saved values' cheap closures.
+    region: set[str] = set()
+    seeds: set[str] = set()
+    targets: set[str] = set()
+    for name in saved_names:
+        c = closure(name)
+        if c is None or not c[0]:
+            seeds.add(name)
+            targets.add(name)
+            continue
+        chain, frontier = c
+        targets.add(name)
+        seeds |= frontier
+        region.add(name)
+        for b in chain:
+            for o in b.flat_proxy_outs:
+                region.add(o.name)
+            for a in b.flat_proxy_args:
+                region.add(a.name)
+    if not region or len(region) > 4096:
+        return None
+
+    all_nodes = sorted(region | seeds | targets)
+    idx: dict[str, int] = {}
+    n = 2  # 0 = S, 1 = T
+    for name in all_nodes:
+        idx[name] = n
+        n += 2  # v_in = idx, v_out = idx + 1
+
+    edges: list[tuple] = []
+    for name in all_nodes:
+        vi, vo = idx[name], idx[name] + 1
+        cap = max(size_of(name), 1)
+        edges.append((vi, vo, cap))
+        if name in seeds or name in arg_proxies:
+            edges.append((0, vi, INF_CAP))
+        if name in targets:
+            edges.append((vo, 1, INF_CAP))
+        b = producers.get(name)
+        if name not in seeds and name not in arg_proxies and b is not None and _is_cheap(b):
+            for a in b.flat_proxy_args:
+                if a.name in idx:
+                    edges.append((idx[a.name] + 1, vi, INF_CAP))
+
+    try:
+        _, source_side = min_cut(n, edges, 0, 1)
+    except Exception:
+        return None
+
+    cut = {name for name in all_nodes if idx[name] in source_side and idx[name] + 1 not in source_side}
+    if not cut:
+        return None
+    return cut
 
 
 def _fw_primal_outputs(fw_trace: TraceCtx):
