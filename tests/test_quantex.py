@@ -1,0 +1,70 @@
+"""Int8 quantized linear executor (TransformerEngine FP8 seat).
+
+Reference parity: thunder/tests/test_transformer_engine_executor.py —
+opt-in executor, numerics compared against the full-precision path.
+"""
+
+import numpy as np
+import pytest
+
+import thunder_tpu
+import thunder_tpu.torch as ttorch
+from thunder_tpu.extend import resolve_executors
+
+
+def _t(*shape, seed=0, scale=1.0):
+    rng = np.random.RandomState(seed + sum(shape))
+    return (rng.randn(*shape) * scale).astype(np.float32)
+
+
+class TestQuantLinear:
+    def test_opt_in_claims_and_close(self):
+        x, w, b = _t(8, 128), _t(64, 128, seed=1) * 0.1, _t(64, seed=2) * 0.1
+
+        def f(x, w, b):
+            return ttorch.linear(x, w, b)
+
+        qf = thunder_tpu.jit(f, executors=resolve_executors(["quant", "jax"]))
+        pf = thunder_tpu.jit(f, executors=resolve_executors(["jax"]))
+        got = np.asarray(qf(x, w, b))
+        want = np.asarray(pf(x, w, b))
+
+        src = thunder_tpu.last_traces(qf)[-1].python()
+        assert "quant_linear" in src
+
+        # int8 per-channel: ~1% relative error budget
+        rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+        assert rel < 0.02, rel
+
+    def test_not_claimed_by_default(self):
+        x, w = _t(8, 128), _t(64, 128, seed=1)
+        jf = thunder_tpu.jit(lambda x, w: ttorch.linear(x, w))
+        jf(x, w)
+        src = thunder_tpu.last_traces(jf)[-1].python()
+        assert "quant_linear" not in src
+
+    def test_small_k_falls_back(self):
+        x, w = _t(8, 16), _t(4, 16, seed=1)  # K=16 < threshold
+        qf = thunder_tpu.jit(lambda x, w: ttorch.linear(x, w),
+                             executors=resolve_executors(["quant", "jax"]))
+        qf(x, w)
+        src = thunder_tpu.last_traces(qf)[-1].python()
+        assert "quant_linear" not in src
+
+    def test_grad_straight_through(self):
+        """Backward runs full-precision; grads close to the f32 path."""
+        x, w = _t(8, 128), _t(64, 128, seed=1) * 0.1
+
+        def loss(x, w):
+            return ttorch.sum(ttorch.linear(x, w) ** 2.0)
+
+        qvg = thunder_tpu.value_and_grad(loss, executors=resolve_executors(["quant", "jax"]))
+        pvg = thunder_tpu.value_and_grad(loss, executors=resolve_executors(["jax"]))
+        lq, gq = qvg(x, w)
+        lp, gp = pvg(x, w)
+        src = thunder_tpu.last_traces(qvg)[-1].python()
+        assert "quant_linear" in src
+        np.testing.assert_allclose(float(np.asarray(lq)), float(np.asarray(lp)), rtol=5e-2)
+        for a, b in zip(gq, gp):
+            a, b = np.asarray(a), np.asarray(b)
+            assert np.abs(a - b).max() <= 5e-2 * np.abs(b).max() + 1e-4

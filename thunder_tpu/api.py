@@ -45,6 +45,7 @@ from thunder_tpu.core.pytree import tree_flatten, tree_map
 from thunder_tpu.core.trace import TraceCtx, tracectx
 from thunder_tpu.executors import bridge, jaxex, pythonex  # register executors  # noqa: F401
 from thunder_tpu.executors import flashex, pallasex  # higher-priority kernel executors  # noqa: F401
+from thunder_tpu.executors import quantex  # opt-in int8 executor (registered, not default)  # noqa: F401
 from thunder_tpu.executors.passes import del_last_used, transform_for_execution
 from thunder_tpu.extend import resolve_executors
 from thunder_tpu.transforms.common import dce
