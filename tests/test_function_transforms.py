@@ -1,0 +1,42 @@
+"""vmap / jvp function transforms (reference: transforms.py vmap:2051 /
+jvp:2324 — experimental there, staged-function-level here)."""
+
+import numpy as np
+
+import thunder_tpu
+import thunder_tpu.torch as ttorch
+
+
+def test_vmap_batches_over_leading_axis():
+    def f(x, w):
+        return ttorch.sum(ttorch.tanh(ttorch.linear(x, w)))
+
+    xs = np.random.RandomState(0).randn(5, 4, 8).astype(np.float32)
+    w = np.random.RandomState(1).randn(3, 8).astype(np.float32)
+    out = np.asarray(thunder_tpu.vmap(f, in_axes=(0, None))(xs, w))
+    want = np.array([np.tanh(x @ w.T).sum() for x in xs], dtype=np.float32)
+    np.testing.assert_allclose(out, want, rtol=1e-3, atol=1e-4)
+
+
+def test_jvp_forward_mode():
+    def g(x):
+        return ttorch.sum(ttorch.exp(x))
+
+    x = np.random.RandomState(2).randn(3, 3).astype(np.float32)
+    t = np.ones_like(x)
+    p, tg = thunder_tpu.jvp(g, (x,), (t,))
+    np.testing.assert_allclose(float(p), np.exp(x).sum(), rtol=1e-4)
+    np.testing.assert_allclose(float(tg), np.exp(x).sum(), rtol=1e-4)
+
+
+def test_jvp_linear_map():
+    def g(x, w):
+        return ttorch.linear(x, w)
+
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    w = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    tx = np.random.RandomState(2).randn(2, 4).astype(np.float32)
+    tw = np.zeros_like(w)
+    p, t = thunder_tpu.jvp(g, (x, w), (tx, tw))
+    np.testing.assert_allclose(np.asarray(p), x @ w.T, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(t), tx @ w.T, rtol=1e-4, atol=1e-5)

@@ -193,6 +193,41 @@ class TestShape:
         _cmp(lambda x: ttorch.transpose(x, -2, -1), lambda x: x.transpose(-2, -1), _t(2, 3, 4))
 
 
+class TestEinsum:
+    @pytest.mark.parametrize(
+        "eq,shapes",
+        [
+            ("ij,jk->ik", [(4, 5), (5, 6)]),
+            ("bij,bjk->bik", [(2, 4, 5), (2, 5, 6)]),
+            ("bhqd,bhkd->bhqk", [(2, 3, 4, 8), (2, 3, 5, 8)]),
+            ("ij->ji", [(4, 5)]),
+            ("ij->i", [(4, 5)]),
+            ("ij,ij->ij", [(4, 5), (4, 5)]),
+            ("ij,kj->ik", [(4, 5), (6, 5)]),
+            ("ibnd,jbnd->ijbn", [(3, 2, 4, 5), (6, 2, 4, 5)]),
+            ("ij,j->i", [(4, 5), (5,)]),
+        ],
+    )
+    def test_vs_torch(self, eq, shapes):
+        rng = np.random.RandomState(0)
+        ops = [rng.randn(*s).astype(np.float32) for s in shapes]
+        _cmp(
+            lambda *xs: ttorch.einsum(eq, *xs),
+            lambda *xs: torch.einsum(eq, *xs),
+            *ops,
+        )
+
+    def test_einsum_grad(self):
+        a, b = _t(4, 5), _t(5, 6, seed=1)
+        got = thunder_tpu.value_and_grad(
+            lambda a, b: ttorch.sum(ttorch.einsum("ij,jk->ik", a, b) ** 2.0)
+        )(a, b)
+        ta, tb = torch.from_numpy(a).requires_grad_(True), torch.from_numpy(b).requires_grad_(True)
+        (torch.einsum("ij,jk->ik", ta, tb) ** 2.0).sum().backward()
+        np.testing.assert_allclose(np.asarray(got[1][0]), ta.grad.numpy(), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(got[1][1]), tb.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+
 class TestReductions:
     def test_mean_dims(self):
         _cmp(lambda x: ttorch.mean(x, (0, 2)), lambda x: x.mean(dim=(0, 2)), _t(2, 3, 4))

@@ -19,6 +19,8 @@ from thunder_tpu.api import (  # noqa: F401
     jit,
     grad,
     value_and_grad,
+    vmap,
+    jvp,
     seed,
     compile_data,
     compile_stats,

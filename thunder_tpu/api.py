@@ -454,6 +454,62 @@ def value_and_grad(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
 
 
 # =============================================================================
+# Function transforms: vmap / jvp (reference: transforms.py:2051,2324 —
+# experimental there; here they compose at the staged-function level, where
+# XLA's native batching/forward-mode rules apply to the claimed trace)
+# =============================================================================
+
+
+def _staged_flat_fn(fn: Callable, args: tuple):
+    """Trace+claim fn for the given example args → (flat jax callable,
+    flat example args)."""
+    from thunder_tpu.executors.passes import transform_for_execution
+
+    _, comp = trace_program(fn, args, {})
+    comp = dce(comp)
+    extrace = transform_for_execution(comp, resolve_executors(["jax"]))
+    flat_args, _ = tree_flatten((args, {}))
+    return extrace.python_callable(), flat_args
+
+
+def vmap(fn: Callable, in_axes=0, out_axes=0) -> Callable:
+    """Vectorizing map over the traced program (experimental)."""
+    import jax
+
+    def vmapped(*args, **kwargs):
+        check(not kwargs, "vmap kwargs are not supported", NotImplementedError)
+        # Trace on one slice; batch the staged function.
+        def slice_ax(x, ax):
+            if ax is None or not hasattr(x, "shape"):
+                return x
+            import numpy as np
+
+            return np.asarray(x).take(0, axis=ax)
+
+        axes = in_axes if isinstance(in_axes, (tuple, list)) else (in_axes,) * len(args)
+        example = tuple(slice_ax(a, ax) for a, ax in zip(args, axes))
+        flat_fn, _ = _staged_flat_fn(fn, example)
+        flat_axes = []
+        for a, ax in zip(args, axes):
+            flat_a, _ = tree_flatten(a)
+            flat_axes.extend([ax if bridge.is_concrete_tensor(x) else None for x in flat_a])
+        flat_args = [bridge.to_jax(x) for x in tree_flatten((args, {}))[0]]
+        return jax.jit(jax.vmap(flat_fn, in_axes=flat_axes, out_axes=out_axes))(*flat_args)
+
+    return vmapped
+
+
+def jvp(fn: Callable, primals: tuple, tangents: tuple):
+    """Forward-mode derivative of the traced program (experimental)."""
+    import jax
+
+    flat_fn, _ = _staged_flat_fn(fn, tuple(primals))
+    flat_p = [bridge.to_jax(x) for x in tree_flatten((tuple(primals), {}))[0]]
+    flat_t = [bridge.to_jax(x) for x in tree_flatten((tuple(tangents), {}))[0]]
+    return jax.jvp(flat_fn, tuple(flat_p), tuple(flat_t))
+
+
+# =============================================================================
 # Introspection (reference: thunder/__init__.py:697-793)
 # =============================================================================
 

@@ -718,6 +718,88 @@ def outer(a, b):
     return clang.mul(clang.unsqueeze(a, 1), clang.unsqueeze(b, 0))
 
 
+@torchsymbol("torch.einsum")
+def einsum(equation: str, *operands):
+    """Einstein summation decomposed to transpose/reshape/matmul prims (so
+    the contraction lands on the MXU). Supports 1-2 operands, no repeated
+    indices within an operand; '...' broadcasting is not supported yet."""
+    if len(operands) == 1 and isinstance(operands[0], (tuple, list)):
+        operands = tuple(operands[0])
+    check("..." not in equation, "einsum ellipsis is not supported yet")
+    eq = equation.replace(" ", "")
+    if "->" in eq:
+        lhs, out_spec = eq.split("->")
+    else:
+        lhs = eq
+        # implicit output: non-repeated indices, sorted
+        counts: dict[str, int] = {}
+        for ch in lhs.replace(",", ""):
+            counts[ch] = counts.get(ch, 0) + 1
+        out_spec = "".join(sorted(ch for ch, n in counts.items() if n == 1))
+    specs = lhs.split(",")
+    check(len(specs) == len(operands), "einsum operand count mismatch")
+    check(len(operands) in (1, 2), "einsum supports 1 or 2 operands")
+
+    if len(operands) == 1:
+        (spec,), (a,) = specs, operands
+        check(len(set(spec)) == len(spec), "repeated in-operand indices unsupported")
+        # sum out dims absent from output, then permute
+        sum_dims = tuple(i for i, ch in enumerate(spec) if ch not in out_spec)
+        if sum_dims:
+            a = clang.sum(a, sum_dims)
+            spec = "".join(ch for ch in spec if ch in out_spec)
+        perm = tuple(spec.index(ch) for ch in out_spec)
+        return clang.permute(a, perm) if perm != tuple(range(len(perm))) else a
+
+    sa, sb = specs
+    a, b = operands
+    check(len(set(sa)) == len(sa) and len(set(sb)) == len(sb),
+          "repeated in-operand indices unsupported")
+    # classify indices
+    batch = [ch for ch in sa if ch in sb and ch in out_spec]
+    contract = [ch for ch in sa if ch in sb and ch not in out_spec]
+    free_a = [ch for ch in sa if ch not in sb]
+    free_b = [ch for ch in sb if ch not in sa]
+    # sum out indices appearing in only one operand and not the output
+    pre_a = tuple(i for i, ch in enumerate(sa) if ch in free_a and ch not in out_spec)
+    if pre_a:
+        a = clang.sum(a, pre_a)
+        sa = "".join(ch for i, ch in enumerate(sa) if i not in pre_a)
+        free_a = [ch for ch in free_a if ch in sa]
+    pre_b = tuple(i for i, ch in enumerate(sb) if ch in free_b and ch not in out_spec)
+    if pre_b:
+        b = clang.sum(b, pre_b)
+        sb = "".join(ch for i, ch in enumerate(sb) if i not in pre_b)
+        free_b = [ch for ch in free_b if ch in sb]
+
+    def dims_of(spec, chs):
+        return {ch: spec.index(ch) for ch in chs}
+
+    da, db = dims_of(sa, sa), dims_of(sb, sb)
+    size = {}
+    for spec, op in ((sa, a), (sb, b)):
+        for i, ch in enumerate(spec):
+            size[ch] = op.shape[i]
+
+    def prod(chs):
+        n = 1
+        for ch in chs:
+            n *= size[ch]
+        return n
+
+    # a → (batch, free_a, contract); b → (batch, contract, free_b)
+    a_perm = tuple(da[ch] for ch in batch + free_a + contract)
+    b_perm = tuple(db[ch] for ch in batch + contract + free_b)
+    a2 = clang.reshape(clang.permute(a, a_perm), (prod(batch), prod(free_a), prod(contract)))
+    b2 = clang.reshape(clang.permute(b, b_perm), (prod(batch), prod(contract), prod(free_b)))
+    o = clang.matmul(a2, b2)  # (batch, free_a, free_b)
+    o = clang.reshape(o, tuple(size[ch] for ch in batch) + tuple(size[ch] for ch in free_a)
+                      + tuple(size[ch] for ch in free_b))
+    cur = batch + free_a + free_b
+    perm = tuple(cur.index(ch) for ch in out_spec)
+    return clang.permute(o, perm) if perm != tuple(range(len(perm))) else o
+
+
 @torchsymbol("torch.nn.functional.embedding")
 def embedding(indices, weight, padding_idx=None, max_norm=None, norm_type: float = 2.0,
               scale_grad_by_freq: bool = False, sparse: bool = False):
