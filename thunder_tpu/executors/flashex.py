@@ -88,11 +88,19 @@ def _flash(q, k, v, *, causal: bool, sm_scale: float):
     from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes, flash_attention
 
     S = q.shape[-2]
-    b = min(_BLOCK, S)
+    # Bigger blocks amortize the online-softmax bookkeeping: 512 measured
+    # 1.6× faster than 128 at S=2048 on v5e (block sweep in commit history).
+    def fit(pref):
+        b = min(pref, S)
+        while S % b:
+            b //= 2
+        return max(b, 1)
+
+    b, bb = fit(512), fit(256)
     sizes = BlockSizes(
         block_q=b, block_k_major=b, block_k=b, block_b=1,
-        block_q_major_dkv=b, block_k_major_dkv=b, block_k_dkv=b, block_q_dkv=b,
-        block_k_major_dq=b, block_k_dq=b, block_q_dq=b,
+        block_q_major_dkv=bb, block_k_major_dkv=bb, block_k_dkv=bb, block_q_dkv=bb,
+        block_k_major_dq=bb, block_k_dq=bb, block_q_dq=bb,
     )
     # The kernel's internal index math assumes 32-bit Python-int weak types;
     # scope out the runtime's x64 mode while tracing it.
