@@ -135,6 +135,39 @@ def scenario_tp_fsdp_train():
     print("tp_fsdp_train OK", float(loss))
 
 
+def scenario_broadcast_grad():
+    """Broadcast's VJP: the summed cotangent lands on the root rank only;
+    non-root ranks get zero gradient (ADVICE r1 fix)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import thunder_tpu.torch as ttorch
+    from thunder_tpu.distributed import prims as dist
+    from thunder_tpu.distributed.runtime import compile_with_collectives
+    from thunder_tpu.parallel import make_mesh
+
+    mesh = make_mesh(dp=8)
+    x = (np.arange(8, dtype=np.float32) + 1.0).reshape(8, 1)
+
+    def f(a):
+        b = dist.broadcast(a, "dp", 8, root=3)
+        return ttorch.sum(b * b)
+
+    jf, extrace = compile_with_collectives(
+        f, (x[:1],), mesh, (P("dp", None),), (P(), (P("dp", None),)), grad=True
+    )
+    loss, (g,) = jf(jnp.asarray(x))
+    # Per-device output is x[3]; replicated loss = x[3]^2 = 16.
+    np.testing.assert_allclose(float(loss), 16.0)
+    # Each of the 8 replicas contributes cotangent 2*x[3]=8; the sum (64)
+    # belongs to the root rank, everyone else gets exactly zero.
+    want = np.zeros((8, 1), dtype=np.float32)
+    want[3, 0] = 64.0
+    np.testing.assert_allclose(np.asarray(g), want)
+    assert "mask_to_rank" in extrace.python()
+    print("broadcast_grad OK")
+
+
 def scenario_fsdp_api():
     import jax
 

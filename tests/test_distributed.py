@@ -143,6 +143,9 @@ class TestMultiDevice:
     def test_fsdp_api(self):
         _run_scenario("fsdp_api")
 
+    def test_broadcast_grad(self):
+        _run_scenario("broadcast_grad")
+
 
 class TestSequenceParallel:
     """Long-context parallelism — ring + Ulysses attention over the sp axis

@@ -177,3 +177,47 @@ def test_no_caching_option():
     jfoo(a)
     jfoo(a)
     assert ttpu.cache_misses(jfoo) == 2
+
+
+def test_structure_change_is_guard_miss():
+    """Pytree changes (sequence length, dict keys) are controlled cache
+    misses, not raw unpack crashes (ADVICE r1: GuardFailure signal)."""
+
+    def foo(pair, cfg):
+        return clang.add(clang.mul(pair[0], cfg["scale"]), pair[-1])
+
+    jfoo = ttpu.jit(foo)
+    a = np.random.randn(2, 3).astype(np.float32)
+    b = np.random.randn(2, 3).astype(np.float32)
+    jfoo((a, b), {"scale": 3.0})
+    # longer tuple → miss, recompile, correct result
+    out = jfoo((a, b, b), {"scale": 3.0})
+    np.testing.assert_allclose(np.asarray(out), a * 3 + b, rtol=1e-5)
+    # different dict key → miss, not a KeyError
+    out = jfoo((a, b), {"scale": 3.0, "extra": 1.0})
+    np.testing.assert_allclose(np.asarray(out), a * 3 + b, rtol=1e-5)
+    assert ttpu.cache_misses(jfoo) == 3
+
+
+def test_prologue_bug_propagates():
+    """A genuine exception raised while probing the cache must propagate,
+    not silently recompile (ADVICE r1: the blanket `except Exception` made
+    real failures invisible)."""
+
+    def foo(a):
+        return clang.neg(a)
+
+    jfoo = ttpu.jit(foo)
+    a = np.random.randn(3).astype(np.float32)
+    jfoo(a)
+
+    cs = ttpu.compile_stats(jfoo)
+
+    def broken_prologue(*args, **kwargs):
+        raise RuntimeError("genuine guard-code bug")
+
+    import dataclasses
+
+    cs.cache_entries[0] = dataclasses.replace(cs.cache_entries[0], prologue_fn=broken_prologue)
+    with pytest.raises(RuntimeError, match="genuine guard-code bug"):
+        jfoo(a)

@@ -126,6 +126,9 @@ class TestBackward:
         np.testing.assert_allclose(x.grad.numpy(), x2.grad.numpy(), rtol=1e-3, atol=1e-4)
 
     def test_optimizer_step_matches_eager(self):
+        """Plain training loop — no manual resync: __call__ detects the
+        in-place optimizer update via torch._version and re-bridges params
+        (ADVICE r1: stale device copies made optimizer steps no-ops)."""
         _seed()
         m_ref = MLP()
         m_jit = MLP()
@@ -141,7 +144,6 @@ class TestBackward:
             opt_jit.zero_grad()
             F.mse_loss(tm(x), t).backward()
             opt_jit.step()
-            tm._resync_params()  # params changed → refresh device copies
 
             opt_ref.zero_grad()
             F.mse_loss(m_ref(x), t).backward()
@@ -151,6 +153,49 @@ class TestBackward:
             np.testing.assert_allclose(
                 p1.detach().numpy(), p2.detach().numpy(), rtol=1e-3, atol=1e-4, err_msg=n1
             )
+
+    def test_training_loss_decreases_without_resync(self):
+        _seed()
+        m = MLP()
+        tm = thunder_tpu.jit(m)
+        opt = torch.optim.Adam(m.parameters(), lr=1e-2)
+        x = torch.randn(16, 8)
+        t = torch.randn(16, 4)
+        losses = []
+        for _ in range(10):
+            opt.zero_grad()
+            loss = F.mse_loss(tm(x), t)
+            loss.backward()
+            opt.step()
+            losses.append(float(loss))
+        assert losses[-1] < 0.5 * losses[0], losses
+
+    def test_mixed_requires_grad_inputs(self):
+        """A non-requires-grad tensor input preceding a requires-grad one:
+        backward must route cotangents to the right slots (ADVICE r1: the
+        grad-slot indexing counted all inputs and raised IndexError here)."""
+
+        class TwoInput(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+
+            def forward(self, a, b):
+                return (self.fc(b) * a).sum()
+
+        _seed()
+        m = TwoInput()
+        tm = thunder_tpu.jit(m)
+        a = torch.randn(4, 8)  # requires_grad=False, comes first
+        b = torch.randn(4, 8, requires_grad=True)
+        out = tm(a, b)
+        out.backward()
+        assert a.grad is None
+        assert b.grad is not None
+
+        b2 = b.detach().clone().requires_grad_(True)
+        m(a, b2).backward()
+        np.testing.assert_allclose(b.grad.numpy(), b2.grad.numpy(), rtol=1e-3, atol=1e-4)
 
     def test_attention_backward(self):
         _seed()

@@ -28,7 +28,7 @@ from thunder_tpu.common import (
     timer_ns,
 )
 from thunder_tpu.core import dtypes, prims
-from thunder_tpu.core.baseutils import check
+from thunder_tpu.core.baseutils import GuardFailure, check
 from thunder_tpu.core.codeutils import SigInfo
 from thunder_tpu.core.langctxs import Languages, langctx_ctx, resolve_language
 from thunder_tpu.core.prims import OpTags, PrimIDs
@@ -123,6 +123,9 @@ def _build_prologue(
 
         def unpack_into(coll_proxy: CollectionProxy, concrete: Any, proxied: Any) -> None:
             if isinstance(concrete, (tuple, list)):
+                # Structural guard first: a different length raises GuardFailure
+                # (controlled miss) instead of a raw unpack ValueError.
+                prims.check_len(coll_proxy, len(concrete))
                 outs = []
                 sub = []  # (collproxy, concrete, proxied) to recurse
                 for c, p in zip(concrete, proxied):
@@ -140,7 +143,7 @@ def _build_prologue(
                 for cp, c, p in sub:
                     unpack_into(cp, c, p)
             elif isinstance(concrete, dict):
-                prims.check_len(coll_proxy, len(concrete))
+                prims.check_keys(coll_proxy, tuple(concrete.keys()))
                 for k, c in concrete.items():
                     p = proxied[k]
                     if isinstance(c, (tuple, list, dict)):
@@ -157,8 +160,12 @@ def _build_prologue(
 
         if args:
             unpack_into(args_coll, args, proxied_args)
+        else:
+            prims.check_len(args_coll, 0)
         if kwargs:
             unpack_into(kwargs_coll, kwargs, proxied_kwargs)
+        else:
+            prims.check_len(kwargs_coll, 0)
 
         prims.python_return(tuple(tensor_leaves))
 
@@ -404,7 +411,10 @@ def jit(
         for entry in reversed(cs.cache_entries):
             try:
                 flat_inps = entry.prologue_fn(*args, **kwargs)
-            except Exception:
+            except GuardFailure:
+                # Controlled signal from a CHECK_* prim: this entry's guards
+                # don't match → probe the next entry. Any other exception is a
+                # genuine bug (in guard code or user input) and propagates.
                 continue
             cs.cache_hits += 1
             cs.last_trace_cache_stop = timer_ns()

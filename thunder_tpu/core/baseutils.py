@@ -32,6 +32,14 @@ class TagBase:
     pass
 
 
+class GuardFailure(AssertionError):
+    """Raised by prologue CHECK_* prims when a cached entry's guards do not
+    match the current inputs. The cache probe loop catches exactly this type
+    (reference parity: thunder/__init__.py:409-447 treats guard failure as the
+    controlled cache-miss signal); any other exception from a prologue is a
+    genuine bug and propagates."""
+
+
 def check(pred: bool, msg: Callable[[], str] | str, exception_type: Type[Exception] = RuntimeError) -> None:
     """Raise ``exception_type`` with ``msg`` if ``pred`` is falsy. ``msg`` may
     be a thunk so message construction is free on the happy path."""

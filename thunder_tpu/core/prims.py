@@ -73,6 +73,7 @@ class PrimIDs(enum.Enum):
     CHECK_NUMBER_TYPE_AND_VALUE = enum.auto()
     CHECK_STRING_VALUE = enum.auto()
     CHECK_LEN = enum.auto()
+    CHECK_KEYS = enum.auto()
     CHECK_NONE = enum.auto()
     # Utility
     DEL = enum.auto()
@@ -330,8 +331,11 @@ def _check_tensor_metadata_meta(
 
 
 def _check_tensor_metadata_impl(t, shape, device, dtype, requires_grad, framework="any") -> None:
-    from thunder_tpu.executors.bridge import framework_of, tensor_metadata
+    from thunder_tpu.core.baseutils import GuardFailure
+    from thunder_tpu.executors.bridge import framework_of, is_concrete_tensor, tensor_metadata
 
+    if not is_concrete_tensor(t):
+        raise GuardFailure(f"Expected a tensor, got {type(t).__name__}")
     actual_shape, actual_device, actual_dtype, actual_rg = tensor_metadata(t)
     if (
         tuple(actual_shape) != tuple(shape)
@@ -340,7 +344,7 @@ def _check_tensor_metadata_impl(t, shape, device, dtype, requires_grad, framewor
         or actual_device.split(":")[0] != str(device).split(":")[0]
         or (framework != "any" and framework_of(t) != framework)
     ):
-        raise AssertionError(
+        raise GuardFailure(
             f"Tensor metadata changed: expected {tuple(shape)}/{dtype}/{device}/rg={requires_grad}/{framework}, "
             f"got {tuple(actual_shape)}/{actual_dtype}/{actual_device}/rg={actual_rg}/{framework_of(t)}"
         )
@@ -360,12 +364,14 @@ def _check_number_meta(n: Any, value: Number) -> None:
 
 
 def _check_number_impl(n, value) -> None:
+    from thunder_tpu.core.baseutils import GuardFailure
+
     if isinstance(n, NumberProxy):
         n = n.value
     if type(n) is not type(value):
-        raise AssertionError(f"Number type changed: expected {type(value).__name__}, got {type(n).__name__}")
+        raise GuardFailure(f"Number type changed: expected {type(value).__name__}, got {type(n).__name__}")
     if not (n == value or (n != n and value != value)):
-        raise AssertionError(f"Number value changed: expected {value}, got {n}")
+        raise GuardFailure(f"Number value changed: expected {value}, got {n}")
 
 
 check_number_type_and_value = make_prim(
@@ -382,8 +388,10 @@ def _check_string_meta(s: Any, value: str) -> None:
 
 
 def _check_string_impl(s, value) -> None:
+    from thunder_tpu.core.baseutils import GuardFailure
+
     if s != value:
-        raise AssertionError(f"String value changed: expected {value!r}, got {s!r}")
+        raise GuardFailure(f"String value changed: expected {value!r}, got {s!r}")
 
 
 check_string_value = make_prim(
@@ -400,8 +408,38 @@ def _check_len_meta(seq: Any, length: int) -> None:
 
 
 def _check_len_impl(seq, length) -> None:
-    if len(seq) != length:
-        raise AssertionError(f"Length changed: expected {length}, got {len(seq)}")
+    from thunder_tpu.core.baseutils import GuardFailure
+
+    try:
+        n = len(seq)
+    except TypeError:
+        raise GuardFailure(f"Expected a sized collection, got {type(seq).__name__}")
+    if n != length:
+        raise GuardFailure(f"Length changed: expected {length}, got {n}")
+
+
+def _check_keys_meta(d: Any, keys: tuple) -> None:
+    return None
+
+
+def _check_keys_impl(d, keys) -> None:
+    from thunder_tpu.core.baseutils import GuardFailure
+
+    try:
+        actual = tuple(d.keys())
+    except AttributeError:
+        raise GuardFailure(f"Expected a mapping, got {type(d).__name__}")
+    if actual != tuple(keys):
+        raise GuardFailure(f"Dict keys changed: expected {tuple(keys)}, got {actual}")
+
+
+check_keys = make_prim(
+    PrimIDs.CHECK_KEYS,
+    "check_keys",
+    _check_keys_meta,
+    tags=(OpTags.GUARD_OP, OpTags.DONT_DCE),
+    python_impl=_check_keys_impl,
+)
 
 
 check_len = make_prim(
@@ -418,8 +456,10 @@ def _check_none_meta(x: Any) -> None:
 
 
 def _check_none_impl(x) -> None:
+    from thunder_tpu.core.baseutils import GuardFailure
+
     if x is not None:
-        raise AssertionError(f"Expected None, got {type(x)}")
+        raise GuardFailure(f"Expected None, got {type(x)}")
 
 
 check_none = make_prim(

@@ -34,6 +34,7 @@ class DistOpIDs(enum.Enum):
     WAIT = enum.auto()
     PPERMUTE = enum.auto()
     ALL_TO_ALL = enum.auto()
+    MASK_TO_RANK = enum.auto()
 
 
 _dist_syms: dict[DistOpIDs, Symbol] = {}
@@ -99,6 +100,12 @@ def _ppermute_meta(a: TensorProxy, axis: str, perm: Sequence[tuple]):
     return _out(a)
 
 
+def _mask_to_rank_meta(a: TensorProxy, axis: str, rank: int):
+    """Identity on rank ``rank`` along mesh axis ``axis``, zeros elsewhere
+    (the transpose of broadcast's replicate-from-root forward)."""
+    return _out(a)
+
+
 def _all_to_all_meta(a: TensorProxy, axis: str, group_size: int, *, split_dim: int, concat_dim: int):
     check(a.shape[split_dim] % group_size == 0, "all_to_all split dim not divisible by group size")
     shape = list(a.shape)
@@ -115,6 +122,7 @@ synchronize = _make(DistOpIDs.SYNCHRONIZE, "synchronize", _synchronize_meta)
 wait = _make(DistOpIDs.WAIT, "wait", _wait_meta)
 ppermute = _make(DistOpIDs.PPERMUTE, "ppermute", _ppermute_meta)
 all_to_all = _make(DistOpIDs.ALL_TO_ALL, "all_to_all", _all_to_all_meta)
+mask_to_rank = _make(DistOpIDs.MASK_TO_RANK, "mask_to_rank", _mask_to_rank_meta)
 
 register_module("dist_prims", __import__("sys").modules[__name__])
 
@@ -164,6 +172,10 @@ def _register_jax_impls():
     def a2a(a, axis, group_size, *, split_dim, concat_dim):
         return lax.all_to_all(a, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=True)
 
+    def mask(a, axis, rank):
+        idx = lax.axis_index(axis)
+        return jax.numpy.where(idx == rank, a, jax.numpy.zeros_like(a))
+
     jax_ex.register_implementation(DistOpIDs.ALL_GATHER, fn=ag)
     jax_ex.register_implementation(DistOpIDs.ALL_REDUCE, fn=ar)
     jax_ex.register_implementation(DistOpIDs.BROADCAST, fn=bc)
@@ -172,6 +184,7 @@ def _register_jax_impls():
     jax_ex.register_implementation(DistOpIDs.WAIT, fn=lambda fut: fut)
     jax_ex.register_implementation(DistOpIDs.PPERMUTE, fn=pp)
     jax_ex.register_implementation(DistOpIDs.ALL_TO_ALL, fn=a2a)
+    jax_ex.register_implementation(DistOpIDs.MASK_TO_RANK, fn=mask)
 
 
 _register_jax_impls()
@@ -205,8 +218,11 @@ def _register_vjps():
 
     @register_vjp(DistOpIDs.BROADCAST)
     def _bc_vjp(bsym, g):
+        # Only the root's input affects the output, so the summed cotangent
+        # belongs to the root alone; non-root ranks get zero (ADVICE r1).
         a, axis, group_size = bsym.args[:3]
-        return (all_reduce(g, axis, group_size), None, None)
+        root = bsym.kwargs.get("root", 0)
+        return (mask_to_rank(all_reduce(g, axis, group_size), axis, root), None, None)
 
     @register_vjp(DistOpIDs.WAIT)
     def _wait_vjp(bsym, g):

@@ -20,6 +20,7 @@ _guard_ids = (
     PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE,
     PrimIDs.CHECK_STRING_VALUE,
     PrimIDs.CHECK_LEN,
+    PrimIDs.CHECK_KEYS,
     PrimIDs.CHECK_NONE,
 )
 

@@ -23,10 +23,22 @@ register_executor(ex)
 _MIN_K = 64  # too-small contractions are not worth quantizing
 
 
+_QUANTIZABLE = None  # set lazily: dtypes the int8 path may replace
+
+
 def _linear_checker(a, w, bias=None) -> bool:
+    global _QUANTIZABLE
+    if _QUANTIZABLE is None:
+        from thunder_tpu.core import dtypes
+
+        _QUANTIZABLE = (dtypes.float32, dtypes.bfloat16, dtypes.float16)
     if not (hasattr(a, "shape") and hasattr(w, "shape")):
         return False
     if len(w.shape) != 2 or w.shape[1] < _MIN_K:
+        return False
+    # Quantization only replaces standard float matmuls; f64 (precision
+    # contract) and integer linears stay with the default executor.
+    if getattr(a, "dtype", None) not in _QUANTIZABLE or getattr(w, "dtype", None) not in _QUANTIZABLE:
         return False
     return True
 
@@ -70,23 +82,10 @@ def _quant_linear_impl(a, w, bias=None):
     return out.astype(orig_dtype)
 
 
-def _quant_linear_grad(bsym, g):
-    """Straight-through backward in the original dtype (reference: TE's
-    higher-precision backward, transformer_engineex.py:423)."""
-    import thunder_tpu.clang as clang
-
-    a, w = bsym.args[0], bsym.args[1]
-    bias = bsym.args[2] if len(bsym.args) > 2 else None
-    ga = clang.matmul(g, w)
-    batch = 1
-    for s in a.shape[:-1]:
-        batch *= s
-    a2 = clang.reshape(a, (batch, a.shape[-1]))
-    g2 = clang.reshape(g, (batch, w.shape[0]))
-    gw = clang.matmul(clang.matrix_transpose(g2), a2)
-    gbias = clang.sum(g, tuple(range(g.ndim - 1))) if bias is not None else None
-    return (ga, gw, gbias)
-
+# Backward note: autodiff decomposes `linear` before claiming, so the grad
+# trace's matmuls fall to the default executor in the original dtype — TE's
+# "int8/fp8 forward, higher-precision backward" recipe without a bespoke rule
+# (reference: transformer_engineex.py:423).
 
 from thunder_tpu.core.prims import PrimIDs  # noqa: E402
 

@@ -163,9 +163,15 @@ class ThunderModule:
 
         self._params: dict[str, Any] = {}  # qual name → jax array
         self._requires_grad: dict[str, bool] = {}
+        # (id, torch._version) per param: in-place updates (optimizer.step)
+        # bump _version, wholesale replacement changes id — either marks the
+        # jax copy stale and __call__ re-bridges it (ADVICE r1: without this,
+        # optimizer steps silently had no effect on the compiled forward).
+        self._versions: dict[str, tuple] = {}
         for qual, _, _, t in _named_slots(module):
             self._params[qual] = bridge.to_jax(t.detach())
             self._requires_grad[qual] = bool(getattr(t, "requires_grad", False))
+            self._versions[qual] = (id(t), getattr(t, "_version", None))
 
     # -- module surface (reference: thunder/__init__.py:246-250) --------------
 
@@ -177,11 +183,29 @@ class ThunderModule:
         self._resync_params()
         return r
 
-    def _resync_params(self) -> None:
+    def resync_params(self) -> None:
+        """Re-bridge every torch param/buffer to its device-side jax copy.
+
+        Called automatically by ``__call__`` for params whose torch tensor
+        changed (in-place update or replacement) since the last bridge; public
+        for manual use after out-of-band mutations the version counter cannot
+        see (e.g. ``param.data`` pointer tricks)."""
         from thunder_tpu.executors import bridge
 
         for qual, _, _, t in _named_slots(self._module):
             self._params[qual] = bridge.to_jax(t.detach())
+            self._versions[qual] = (id(t), getattr(t, "_version", None))
+
+    _resync_params = resync_params  # backwards-compatible private alias
+
+    def _refresh_stale_params(self) -> None:
+        from thunder_tpu.executors import bridge
+
+        for qual, _, _, t in _named_slots(self._module):
+            ver = (id(t), getattr(t, "_version", None))
+            if self._versions.get(qual) != ver:
+                self._params[qual] = bridge.to_jax(t.detach())
+                self._versions[qual] = ver
 
     def named_parameters(self, *a, **kw):
         return self._module.named_parameters(*a, **kw)
@@ -236,20 +260,27 @@ class ThunderModule:
         concrete_tensors = [x for x in flat_concrete if bridge.is_concrete_tensor(x)]
         name_of = {id(v): n for n, v in self._params.items()}
         wrt_kinds: list[tuple[str, Any]] = []  # ("input", pos) | ("param", qual)
-        input_pos = 0
+        # input positions index into __call__'s `input_tensors` list, which
+        # holds only the requires-grad differentiable tensor inputs — so the
+        # counter advances only for those (ADVICE r1: counting all non-param
+        # inputs misaligned backward's grad slots).
+        rg_input_pos = 0
         for proxy_arg, conc in zip(comp.args, concrete_tensors):
             qual = name_of.get(id(conc))
             if qual is not None:
                 rg = self._requires_grad[qual]
             else:
                 rg = bool(getattr(conc, "requires_grad", False))
-                input_pos += 1
             from thunder_tpu.core import dtypes as _dt
 
             rg = rg and _dt.is_inexact_dtype(proxy_arg.dtype)
             proxy_arg._requires_grad = rg
             if rg:
-                wrt_kinds.append(("param", qual) if qual is not None else ("input", input_pos - 1))
+                if qual is not None:
+                    wrt_kinds.append(("param", qual))
+                else:
+                    wrt_kinds.append(("input", rg_input_pos))
+                    rg_input_pos += 1
 
         executors = resolve_executors(self._jit_options.get("executors"))
         needs_grad = any(a.requires_grad for a in comp.args if isinstance(a, TensorProxy))
@@ -289,6 +320,7 @@ class ThunderModule:
     def __call__(self, *args, **kwargs):
         from thunder_tpu.executors import bridge
 
+        self._refresh_stale_params()
         key = self._cache_key(args, kwargs)
         entry = self._cache.get(key)
         if entry is None:
