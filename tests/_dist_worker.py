@@ -185,6 +185,130 @@ def scenario_fsdp_api():
     print("fsdp_api OK")
 
 
+def _make_torch_gpt():
+    """Tiny torch GPT (embedding + causal attention + MLP + head) for the
+    module-level distributed scenarios. Dims divisible by 8 so every weight
+    dim-0-shards over the mesh axis."""
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    class Block(nn.Module):
+        def __init__(self, dim=32, heads=4):
+            super().__init__()
+            self.dim, self.heads = dim, heads
+            self.norm1 = nn.LayerNorm(dim)
+            self.qkv = nn.Linear(dim, 3 * dim, bias=False)
+            self.proj = nn.Linear(dim, dim, bias=False)
+            self.norm2 = nn.LayerNorm(dim)
+            self.fc = nn.Linear(dim, 4 * dim)
+            self.out = nn.Linear(4 * dim, dim)
+
+        def forward(self, x):
+            B, T, C = x.shape
+            h = self.norm1(x)
+            qkv = self.qkv(h).view(B, T, 3, self.heads, C // self.heads)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            q, k, v = (t.transpose(1, 2) for t in (q, k, v))
+            y = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            x = x + self.proj(y.transpose(1, 2).reshape(B, T, C))
+            return x + self.out(F.gelu(self.fc(self.norm2(x))))
+
+    class TinyGPT(nn.Module):
+        def __init__(self, vocab=64, dim=32, n_layer=2):
+            super().__init__()
+            self.wte = nn.Embedding(vocab, dim)
+            self.blocks = nn.ModuleList([Block(dim) for _ in range(n_layer)])
+            self.ln_f = nn.LayerNorm(dim)
+            self.head = nn.Linear(dim, vocab, bias=False)
+
+        def forward(self, idx):
+            x = self.wte(idx)
+            for b in self.blocks:
+                x = b(x)
+            return self.head(self.ln_f(x))
+
+    return TinyGPT()
+
+
+def _module_dist_scenario(mode: str):
+    """fsdp()/ddp() on a torch module + thunder_tpu.jit trains on the mesh:
+    loss parity vs single-device, grad-sync collectives in the backward
+    trace, loss decreasing (the reference's flagship workflow,
+    thunder/common.py:521-528 + distributed/prims.py:260-298)."""
+    import torch
+    import torch.nn.functional as F
+
+    import thunder_tpu
+    from thunder_tpu.distributed import ddp, fsdp
+    from thunder_tpu.parallel import make_mesh
+
+    torch.manual_seed(0)
+    m_ref = _make_torch_gpt()
+    m_dist = _make_torch_gpt()
+    m_dist.load_state_dict(m_ref.state_dict())
+
+    if mode == "fsdp":
+        # No mesh passed: resolves the default world (all 8 devices),
+        # matching the reference's bare `fsdp(model)`.
+        m_dist = fsdp(m_dist)
+    else:
+        mesh = make_mesh(dp=8)
+        m_dist = ddp(m_dist, mesh=mesh)
+    tm = thunder_tpu.jit(m_dist)
+    tm_ref = thunder_tpu.jit(m_ref)
+
+    rng = np.random.RandomState(0)
+    idx = torch.from_numpy(rng.randint(0, 64, (8, 16)))
+    tgt = torch.from_numpy(rng.randint(0, 64, (8, 16)))
+
+    opt = torch.optim.SGD(m_dist.parameters() if mode == "ddp" else tm.parameters(), lr=0.1)
+    opt_ref = torch.optim.SGD(m_ref.parameters(), lr=0.1)
+
+    losses = []
+    for step in range(4):
+        opt.zero_grad()
+        logits = tm(idx)
+        loss = F.cross_entropy(logits.reshape(-1, 64), tgt.reshape(-1))
+        loss.backward()
+        opt.step()
+
+        opt_ref.zero_grad()
+        loss_ref = F.cross_entropy(tm_ref(idx).reshape(-1, 64), tgt.reshape(-1))
+        loss_ref.backward()
+        opt_ref.step()
+
+        np.testing.assert_allclose(float(loss.detach()), float(loss_ref.detach()), rtol=1e-4)
+        losses.append(float(loss.detach()))
+    assert losses[-1] < losses[0], losses
+
+    # Grad-sync collectives are IN THE TRACE (not just GSPMD-inserted):
+    entry = next(iter(tm._cache.values()))
+    comp = entry["traces"][0]
+    fw_src = entry["traces"][1].python()
+    bw_src = entry["traces"][2].python()
+    assert "synchronize" in fw_src
+    # Data is batch-sharded: the per-device program sees the local
+    # microbatch (B=8 over 8 devices → local B=1), not 8 redundant copies.
+    assert any(tuple(a.shape)[:1] == (1,) for a in comp.args), [tuple(a.shape) for a in comp.args]
+    if mode == "fsdp":
+        assert "reduce_scatter" in bw_src, bw_src[-2000:]
+        # Params genuinely live dim-0-sharded on the mesh (ZeRO memory win).
+        wte = tm._params["wte.weight"]
+        assert wte.addressable_shards[0].data.shape[0] * 8 == wte.shape[0]
+    else:
+        assert "all_reduce" in bw_src, bw_src[-2000:]
+    print(f"module_{mode}_train OK", losses[0], "->", losses[-1])
+
+
+def scenario_module_fsdp_train():
+    _module_dist_scenario("fsdp")
+
+
+def scenario_module_ddp_train():
+    _module_dist_scenario("ddp")
+
+
 def _full_attention(q, k, v, causal=True):
     import jax
     import jax.numpy as jnp

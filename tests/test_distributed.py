@@ -146,6 +146,15 @@ class TestMultiDevice:
     def test_broadcast_grad(self):
         _run_scenario("broadcast_grad")
 
+    def test_module_fsdp_train(self):
+        """The flagship workflow: fsdp(torch_module) + jit trains on the
+        mesh — loss parity vs single-device, reduce-scatter in the backward
+        trace, params dim-0-sharded on device (VERDICT r1 item 1)."""
+        _run_scenario("module_fsdp_train")
+
+    def test_module_ddp_train(self):
+        _run_scenario("module_ddp_train")
+
 
 class TestSequenceParallel:
     """Long-context parallelism — ring + Ulysses attention over the sp axis

@@ -108,6 +108,15 @@ def _build_prologue(
         args_coll = CollectionProxy(args, name="args")
         kwargs_coll = CollectionProxy(kwargs, name="kwargs")
 
+        from thunder_tpu.core.proxies import AnyProxy
+
+        def slot_proxy(p: Any):
+            """Unpack-output proxy for a leaf. None leaves get a fresh
+            prologue-local AnyProxy so the slot can be guarded with
+            check_none — a None→tensor change must be a controlled miss, not
+            a silent reuse of the trace that baked the constant None in."""
+            return AnyProxy(None, prefix="nil") if p is None else p
+
         def guard_leaf(p: Any, concrete: Any) -> None:
             if isinstance(p, TensorProxy):
                 prims.check_tensor_shape_and_metadata(
@@ -117,9 +126,9 @@ def _build_prologue(
                 prims.check_number_type_and_value(p, p.value)
             elif isinstance(p, StringProxy):
                 prims.check_string_value(p, p.value)
-            elif p is None:
-                pass
-            # AnyProxy: unguarded (sharp edge)
+            elif isinstance(p, AnyProxy) and p.value is None:
+                prims.check_none(p)
+            # other AnyProxy: unguarded (sharp edge)
 
         def unpack_into(coll_proxy: CollectionProxy, concrete: Any, proxied: Any) -> None:
             if isinstance(concrete, (tuple, list)):
@@ -128,18 +137,20 @@ def _build_prologue(
                 prims.check_len(coll_proxy, len(concrete))
                 outs = []
                 sub = []  # (collproxy, concrete, proxied) to recurse
+                leaf_slots = []  # (slot, concrete) to guard
                 for c, p in zip(concrete, proxied):
                     if isinstance(c, (tuple, list, dict)):
                         cp = CollectionProxy(c)
                         outs.append(cp)
                         sub.append((cp, c, p))
                     else:
-                        outs.append(p)
+                        slot = slot_proxy(p)
+                        outs.append(slot)
+                        leaf_slots.append((slot, c))
                 bsym = prims.unpack_sequence.bind(coll_proxy, len(concrete), output=outs)
                 plg.bound_symbols.append(bsym)
-                for c, p in zip(concrete, proxied):
-                    if not isinstance(c, (tuple, list, dict)):
-                        guard_leaf(p, c)
+                for slot, c in leaf_slots:
+                    guard_leaf(slot, c)
                 for cp, c, p in sub:
                     unpack_into(cp, c, p)
             elif isinstance(concrete, dict):
@@ -152,9 +163,10 @@ def _build_prologue(
                         plg.bound_symbols.append(bsym)
                         unpack_into(cp, c, p)
                     else:
-                        bsym = prims.unpack_key.bind(coll_proxy, k, output=p)
+                        slot = slot_proxy(p)
+                        bsym = prims.unpack_key.bind(coll_proxy, k, output=slot)
                         plg.bound_symbols.append(bsym)
-                        guard_leaf(p, c)
+                        guard_leaf(slot, c)
             else:
                 raise NotImplementedError(f"Cannot unpack {type(concrete)}")
 

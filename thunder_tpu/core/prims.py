@@ -429,7 +429,9 @@ def _check_keys_impl(d, keys) -> None:
         actual = tuple(d.keys())
     except AttributeError:
         raise GuardFailure(f"Expected a mapping, got {type(d).__name__}")
-    if actual != tuple(keys):
+    # Order-insensitive: unpacking is key-based and leaf order sorts keys,
+    # so {'a':..,'b':..} and {'b':..,'a':..} share a cache entry.
+    if len(actual) != len(keys) or set(actual) != set(keys):
         raise GuardFailure(f"Dict keys changed: expected {tuple(keys)}, got {actual}")
 
 

@@ -61,11 +61,73 @@ def skip_data_parallel_grad_sync() -> bool:
     return _skip_data_sync.get()
 
 
-def ddp(model_or_params, *, mesh=None, axis: str = "dp", broadcast_from: int = 0):
-    """Mark a params pytree (or ThunderModule) replicated for data-parallel
-    training (reference: `ddp:88`). On the mesh path this resolves to
-    replicated param specs + batch-sharded data; grad sync is a psum the
-    partitioner inserts from the sharding contract."""
+def _is_torch_module(x) -> bool:
+    try:
+        import torch
+
+        return isinstance(x, torch.nn.Module)
+    except ImportError:
+        return False
+
+
+def _is_thunder_module(x) -> bool:
+    from thunder_tpu.frontend.module import ThunderModule
+
+    return isinstance(x, ThunderModule)
+
+
+def _validate_dist_cfg(cfg: dict) -> None:
+    mesh = cfg.get("mesh")
+    if mesh is None:
+        # Reference parity: `fsdp(model)` / `ddp(model)` with no process
+        # group uses the default world. Here the world is all local jax
+        # devices — resolve a 1-axis mesh over them rather than silently
+        # compiling single-device with no sharding/grad-sync.
+        import jax
+        from jax.sharding import Mesh
+        import numpy as _numpy
+
+        devs = jax.devices()
+        cfg["mesh"] = Mesh(_numpy.array(devs), (cfg["axis"],))
+        return
+    axis = cfg.get("axis")
+    if axis not in mesh.axis_names:
+        raise ValueError(
+            f"{cfg.get('mode')}(axis={axis!r}) but the mesh has axes {tuple(mesh.axis_names)}; "
+            f"pass axis=<one of them> (silently compiling single-device would drop the sharding)"
+        )
+
+
+def _attach_dist_config(model, cfg: dict):
+    """Tag a torch module / ThunderModule so the jit pipeline inserts
+    `dist_prims.synchronize` for its params at trace time and stages the
+    compiled traces under shard_map over the mesh (the flagship workflow:
+    reference thunder/common.py:521-528 inserts synchronize for tagged
+    params during tracing; the VJP at distributed/prims.py:260-298 emits
+    grad sync into the backward)."""
+    _validate_dist_cfg(cfg)
+    if _is_thunder_module(model):
+        model.configure_distributed(cfg)
+        return model
+    model._thunder_dist = cfg
+    return model
+
+
+def ddp(model_or_params, *, mesh=None, axis: str = "dp", broadcast_from: Optional[int] = 0):
+    """Mark a model/params replicated for data-parallel training
+    (reference: `ddp:88`).
+
+    - torch ``nn.Module`` / ``ThunderModule``: tags the module; at trace time
+      every param passes through `synchronize` (identity forward, pre-scaled
+      all-reduce backward) and the traces stage under shard_map on ``mesh``.
+      ``broadcast_from`` replicates that rank's initial params to the group
+      (reference `__init__.py:150-163`); pass None to skip.
+    - params pytree of proxies: marks `dist_parallel_type` (trace-level IR).
+    """
+    if _is_torch_module(model_or_params) or _is_thunder_module(model_or_params):
+        cfg = {"mode": "ddp", "mesh": mesh, "axis": axis, "broadcast_from": broadcast_from}
+        return _attach_dist_config(model_or_params, cfg)
+
     from thunder_tpu.core.pytree import tree_map
     from thunder_tpu.core.proxies import TensorProxy
 
@@ -85,10 +147,26 @@ def fsdp(
     bucketing_strategy: FSDPBucketingStrategy = FSDPBucketingStrategy.NONE,
     axis: str = "fsdp",
 ):
-    """Mark a params pytree fully-sharded (reference: `fsdp:303`,
-    dim-0 `_shard_param:406`). With a mesh, returns the pytree device_put
-    with dim-0-sharded NamedShardings — the same layout the reference
-    shards to, expressed as sharding metadata instead of narrowed tensors."""
+    """Mark a model/params fully-sharded (reference: `fsdp:303`,
+    dim-0 `_shard_param:406`).
+
+    - torch ``nn.Module`` / ``ThunderModule``: tags the module; params live
+      dim-0-sharded on the mesh, `synchronize` (all-gather) is inserted at
+      trace time, and the backward carries the grad reduce-scatter — the
+      reference's flagship `fsdp(model); thunder.jit(model)` workflow.
+    - params pytree: marks proxies / device_puts arrays with dim-0-sharded
+      NamedShardings (the GSPMD path).
+    """
+    if _is_torch_module(model_or_params) or _is_thunder_module(model_or_params):
+        cfg = {
+            "mode": "fsdp",
+            "mesh": mesh,
+            "axis": axis,
+            "fsdp_type": sharding_strategy,
+            "bucketing": bucketing_strategy,
+        }
+        return _attach_dist_config(model_or_params, cfg)
+
     from thunder_tpu.core.pytree import tree_map
     from thunder_tpu.core.proxies import TensorProxy
 

@@ -77,13 +77,28 @@ def _reduce_scatter_meta(a: TensorProxy, axis: str, group_size: int, *, op: str 
     return _out(a, shape, future=async_op)
 
 
-def _synchronize_meta(a: TensorProxy, axis: str, group_size: int):
-    """FULLY_SHARDED params enter dim-0-sharded and synchronize to the full
-    tensor (all-gather); REPLICATED params pass through. The VJP rule holds
-    the grad-sync semantics (see autodiff registration below)."""
+def _sync_is_sharded(a, parallel_type: Optional[str]) -> bool:
     from thunder_tpu.core.proxies import DistParallelType
 
-    if a.dist_parallel_type == DistParallelType.FULLY_SHARDED:
+    if parallel_type is not None:
+        return parallel_type == "fsdp"
+    return getattr(a, "dist_parallel_type", None) == DistParallelType.FULLY_SHARDED
+
+
+def _synchronize_meta(
+    a: TensorProxy, axis: str, group_size: int, parallel_type: Optional[str] = None,
+    *, grad_scale: Optional[float] = None,
+):
+    """FULLY_SHARDED params enter dim-0-sharded and synchronize to the full
+    tensor (all-gather); REPLICATED params pass through. The VJP rule holds
+    the grad-sync semantics (see autodiff registration below).
+
+    ``parallel_type`` ("fsdp" | "replicated") records the decision as a
+    static arg so the runtime lowering doesn't depend on trace-time proxy
+    attributes; None falls back to the proxy's dist_parallel_type."""
+    from thunder_tpu.core.proxies import DistParallelType
+
+    if _sync_is_sharded(a, parallel_type):
         shape = (a.shape[0] * group_size,) + tuple(a.shape[1:])
         out = TensorProxy(like=a, shape=shape, requires_grad=a.requires_grad)
         out.dist_parallel_type = DistParallelType.NONE
@@ -161,9 +176,12 @@ def _register_jax_impls():
             r = r / group_size
         return r
 
-    def sync(a, axis, group_size):
-        # Concrete layout decisions live in shardings on the mesh path; when
-        # executed inside shard_map the sharded param is gathered here.
+    def sync(a, axis, group_size, parallel_type=None, *, grad_scale=None):
+        # FSDP shards all-gather to the full param; replicated params pass
+        # through (their sync semantics live entirely in the VJP's grad
+        # all-reduce). None = legacy call sites that always gather.
+        if parallel_type == "replicated":
+            return a
         return lax.all_gather(a, axis, axis=0, tiled=True) if group_size > 1 else a
 
     def pp(a, axis, perm):
@@ -233,13 +251,20 @@ def _register_vjps():
         import thunder_tpu.clang as clang
 
         a, axis, group_size = bsym.args[:3]
-        if a.dist_parallel_type == DistParallelType.FULLY_SHARDED:
+        ptype = bsym.args[3] if len(bsym.args) > 3 else bsym.kwargs.get("parallel_type")
+        # grad_scale: 1/world when every device redundantly computes the
+        # full-batch grad (replicated data — averaging the identical copies
+        # is the identity); 1.0 when data is batch-sharded and per-device
+        # partial grads must SUM to the global grad.
+        scale = bsym.kwargs.get("grad_scale")
+        if scale is None:
+            scale = 1.0 / group_size
+        scaled = clang.mul(g, scale) if scale != 1.0 else g
+        if _sync_is_sharded(a, ptype):
             # FSDP: grad of the gathered param reduce-scatters back to shards
-            # after pre-scaling by 1/world (reference: prims.py:286-298).
-            scaled = clang.mul(g, 1.0 / group_size)
+            # (reference: prims.py:286-298).
             return (reduce_scatter(scaled, axis, group_size, dim=0), None, None)
-        # DDP (replicated): pre-divide then all-reduce.
-        scaled = clang.mul(g, 1.0 / group_size)
+        # DDP (replicated): all-reduce.
         return (all_reduce(scaled, axis, group_size), None, None)
 
 

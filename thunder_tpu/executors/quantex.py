@@ -23,15 +23,12 @@ register_executor(ex)
 _MIN_K = 64  # too-small contractions are not worth quantizing
 
 
-_QUANTIZABLE = None  # set lazily: dtypes the int8 path may replace
+from thunder_tpu.core import dtypes  # noqa: E402
+
+_QUANTIZABLE = (dtypes.float32, dtypes.bfloat16, dtypes.float16)
 
 
 def _linear_checker(a, w, bias=None) -> bool:
-    global _QUANTIZABLE
-    if _QUANTIZABLE is None:
-        from thunder_tpu.core import dtypes
-
-        _QUANTIZABLE = (dtypes.float32, dtypes.bfloat16, dtypes.float16)
     if not (hasattr(a, "shape") and hasattr(w, "shape")):
         return False
     if len(w.shape) != 2 or w.shape[1] < _MIN_K:

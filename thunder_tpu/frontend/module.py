@@ -155,11 +155,13 @@ class ThunderModule:
     """Compiled wrapper around a torch.nn.Module (reference: __init__.py:178)."""
 
     def __init__(self, module, **jit_options):
-        from thunder_tpu.executors import bridge
-
         self._module = module
         self._jit_options = jit_options
         self._cache: dict[Any, dict] = {}
+
+        # ddp()/fsdp() tag the torch module before jit (reference workflow
+        # `fsdp(model); thunder.jit(model)`, thunder/distributed/__init__.py:303).
+        self._dist: Optional[dict] = getattr(module, "_thunder_dist", None)
 
         self._params: dict[str, Any] = {}  # qual name → jax array
         self._requires_grad: dict[str, bool] = {}
@@ -167,11 +169,69 @@ class ThunderModule:
         # bump _version, wholesale replacement changes id — either marks the
         # jax copy stale and __call__ re-bridges it (ADVICE r1: without this,
         # optimizer steps silently had no effect on the compiled forward).
+        # The torch tensor itself is held (not just id()) so a freed
+        # address can't alias a replacement param into looking unchanged.
         self._versions: dict[str, tuple] = {}
         for qual, _, _, t in _named_slots(module):
-            self._params[qual] = bridge.to_jax(t.detach())
+            self._params[qual] = self._bridge_param(qual, t)
             self._requires_grad[qual] = bool(getattr(t, "requires_grad", False))
-            self._versions[qual] = (id(t), getattr(t, "_version", None))
+            self._versions[qual] = (t, getattr(t, "_version", None))
+
+    # -- distributed (reference: thunder/distributed/__init__.py:88,303) -------
+
+    def configure_distributed(self, cfg: Optional[dict]) -> None:
+        """Install a ddp/fsdp config ({mode, mesh, axis, ...}) after jit;
+        clears compiled entries and re-bridges params onto the mesh."""
+        self._dist = cfg
+        self._cache.clear()
+        self.resync_params()
+
+    def _dist_axis_size(self) -> int:
+        d = self._dist
+        if not d or d.get("mesh") is None:
+            return 1
+        mesh = d["mesh"]
+        return dict(zip(mesh.axis_names, mesh.devices.shape)).get(d.get("axis"), 1)
+
+    def _dist_active(self) -> bool:
+        return self._dist_axis_size() > 1
+
+    def _qual_is_sharded(self, qual: str, shape) -> bool:
+        """FSDP shards every param dim-0 over the axis when divisible
+        (reference `_shard_param:406`; indivisible params stay replicated,
+        synced like DDP)."""
+        n = self._dist_axis_size()
+        return (
+            self._dist is not None
+            and self._dist.get("mode") == "fsdp"
+            and n > 1
+            and len(shape) >= 1
+            and shape[0] % n == 0
+            and shape[0] >= n
+        )
+
+    def _param_pspec(self, qual: str, ndim: int, sharded: bool):
+        from jax.sharding import PartitionSpec
+
+        if sharded:
+            return PartitionSpec(self._dist["axis"], *([None] * (ndim - 1)))
+        return PartitionSpec()
+
+    def _bridge_param(self, qual: str, t) -> Any:
+        """torch param → jax array; under an active dist config the array is
+        device_put with its NamedSharding so FSDP params genuinely live
+        dim-0-sharded across the mesh (the ZeRO memory win)."""
+        from thunder_tpu.executors import bridge
+
+        arr = bridge.to_jax(t.detach())
+        if self._dist_active():
+            import jax
+            from jax.sharding import NamedSharding
+
+            sharded = self._qual_is_sharded(qual, tuple(arr.shape))
+            spec = self._param_pspec(qual, arr.ndim, sharded)
+            arr = jax.device_put(arr, NamedSharding(self._dist["mesh"], spec))
+        return arr
 
     # -- module surface (reference: thunder/__init__.py:246-250) --------------
 
@@ -190,22 +250,18 @@ class ThunderModule:
         changed (in-place update or replacement) since the last bridge; public
         for manual use after out-of-band mutations the version counter cannot
         see (e.g. ``param.data`` pointer tricks)."""
-        from thunder_tpu.executors import bridge
-
         for qual, _, _, t in _named_slots(self._module):
-            self._params[qual] = bridge.to_jax(t.detach())
-            self._versions[qual] = (id(t), getattr(t, "_version", None))
+            self._params[qual] = self._bridge_param(qual, t)
+            self._versions[qual] = (t, getattr(t, "_version", None))
 
     _resync_params = resync_params  # backwards-compatible private alias
 
     def _refresh_stale_params(self) -> None:
-        from thunder_tpu.executors import bridge
-
         for qual, _, _, t in _named_slots(self._module):
-            ver = (id(t), getattr(t, "_version", None))
-            if self._versions.get(qual) != ver:
-                self._params[qual] = bridge.to_jax(t.detach())
-                self._versions[qual] = ver
+            prev = self._versions.get(qual)
+            if prev is None or prev[0] is not t or prev[1] != getattr(t, "_version", None):
+                self._params[qual] = self._bridge_param(qual, t)
+                self._versions[qual] = (t, getattr(t, "_version", None))
 
     def named_parameters(self, *a, **kw):
         return self._module.named_parameters(*a, **kw)
@@ -232,7 +288,7 @@ class ThunderModule:
 
     # -- compilation ----------------------------------------------------------
 
-    def _compile(self, args: tuple, kwargs: dict) -> dict:
+    def _compile(self, args: tuple, kwargs: dict, _force_replicated_data: bool = False) -> dict:
         import jax
 
         from thunder_tpu.api import trace_program
@@ -243,33 +299,121 @@ class ThunderModule:
         from thunder_tpu.transforms.common import dce
 
         module = self._module
+        dist_n = self._dist_axis_size()
+        dist_axis = self._dist["axis"] if self._dist_active() else None
+
+        # Under an active dist config the staged function runs inside
+        # shard_map: each device sees the LOCAL dim-0 shard of every
+        # fsdp-sharded param — and of every batch-sharded data input — so
+        # the trace is built against local shapes (dim-0 slices keep
+        # dtype/framework/requires_grad).
+        trace_params: dict[str, Any] = self._params
+        sharded_quals: set[str] = set()
+        shard_data = (
+            self._dist_active()
+            and not _force_replicated_data
+            and self._dist.get("shard_data", True)
+        )
+        sharded_data_ids: set[int] = set()
+        trace_args, trace_kwargs = args, kwargs
+        if self._dist_active():
+            trace_params = {}
+            for qual, v in self._params.items():
+                if self._qual_is_sharded(qual, tuple(v.shape)):
+                    sharded_quals.add(qual)
+                    trace_params[qual] = v[: v.shape[0] // dist_n]
+                else:
+                    trace_params[qual] = v
+
+            def data_placeholder(x):
+                """Batch-shard a data input over the dist axis when its
+                leading dim divides; the per-device program then sees the
+                local microbatch (real data-parallel speedup, not N
+                redundant copies of the full batch).
+
+                Sharp edge (documented contract, matching the reference's
+                DDP batch-first requirement): dim 0 of ndim>=2 inputs is
+                assumed to be the batch dim. 1-D inputs (per-class weight
+                vectors etc.) are never sharded; pass shard_data=False in
+                the dist config to disable entirely."""
+                if not (shard_data and bridge.is_concrete_tensor(x)):
+                    return x
+                shape = tuple(x.shape)
+                if len(shape) >= 2 and shape[0] >= dist_n and shape[0] % dist_n == 0:
+                    ph = x[: shape[0] // dist_n]
+                    sharded_data_ids.add(id(ph))
+                    return ph
+                return x
+
+            if shard_data:
+                trace_args = tree_map(data_placeholder, args)
+                trace_kwargs = tree_map(data_placeholder, kwargs)
+
+        # Replicated data → every device computes the identical full-batch
+        # grad, so grad sync averages (1/N). Sharded data → per-device
+        # partial grads must SUM (cotangents arrive from the globally
+        # computed loss).
+        grad_scale = 1.0 if sharded_data_ids else (1.0 / dist_n if dist_n > 1 else 1.0)
 
         def functional_fwd(params: dict, *fargs, **fkwargs):
+            if dist_axis is not None:
+                # Trace-level DDP/FSDP: every param passes through
+                # `synchronize` (reference thunder/common.py:521-528 inserts
+                # it for tagged params at trace time). FSDP shards enter
+                # dim-0-sharded and all-gather to full; replicated params
+                # pass through. The VJP (distributed/prims.py) emits the
+                # grad reduce-scatter / pre-scaled all-reduce into the
+                # compiled backward.
+                from thunder_tpu.core.proxies import DistParallelType
+                from thunder_tpu.distributed import prims as dist_prims
+
+                synced = {}
+                for qual, p in params.items():
+                    if isinstance(p, TensorProxy):
+                        if qual in sharded_quals:
+                            p.dist_parallel_type = DistParallelType.FULLY_SHARDED
+                            ptype = "fsdp"
+                        else:
+                            p.dist_parallel_type = DistParallelType.REPLICATED
+                            ptype = "replicated"
+                        synced[qual] = dist_prims.synchronize(
+                            p, dist_axis, dist_n, ptype, grad_scale=grad_scale
+                        )
+                    else:
+                        synced[qual] = p
+                params = synced
             with _swapped_params(module, params), _patched_factories(), _make_dispatch_mode():
                 out = module(*fargs, **fkwargs)
             return _normalize_output(out)
 
-        _, comp = trace_program(functional_fwd, (self._params,) + args, kwargs)
+        _, comp = trace_program(functional_fwd, (trace_params,) + trace_args, trace_kwargs)
         comp = dce(comp)
 
         # Mark requires_grad on the trace's tensor args. Trace args align
         # with the concrete tensor leaves of ((params, *args), kwargs) in
         # pytree order; params are jax arrays (no requires_grad of their
         # own), so the flags come from the torch module / input tensors.
-        flat_concrete, _ = tree_flatten(((self._params,) + args, kwargs))
+        flat_concrete, _ = tree_flatten(((trace_params,) + trace_args, trace_kwargs))
         concrete_tensors = [x for x in flat_concrete if bridge.is_concrete_tensor(x)]
-        name_of = {id(v): n for n, v in self._params.items()}
+        name_of = {id(v): n for n, v in trace_params.items()}
         wrt_kinds: list[tuple[str, Any]] = []  # ("input", pos) | ("param", qual)
         # input positions index into __call__'s `input_tensors` list, which
         # holds only the requires-grad differentiable tensor inputs — so the
         # counter advances only for those (ADVICE r1: counting all non-param
         # inputs misaligned backward's grad slots).
         rg_input_pos = 0
+        qual_of_argname: dict[str, str] = {}  # trace arg name → param qual
+        sharded_data_argnames: set[str] = set()
+        input_grad_sharded: list[bool] = []  # indexed by rg input pos
+        rg_unsharded_input = False
         for proxy_arg, conc in zip(comp.args, concrete_tensors):
             qual = name_of.get(id(conc))
             if qual is not None:
+                qual_of_argname[proxy_arg.name] = qual
                 rg = self._requires_grad[qual]
             else:
+                if id(conc) in sharded_data_ids:
+                    sharded_data_argnames.add(proxy_arg.name)
                 rg = bool(getattr(conc, "requires_grad", False))
             from thunder_tpu.core import dtypes as _dt
 
@@ -280,25 +424,129 @@ class ThunderModule:
                     wrt_kinds.append(("param", qual))
                 else:
                     wrt_kinds.append(("input", rg_input_pos))
+                    sharded = id(conc) in sharded_data_ids
+                    input_grad_sharded.append(sharded)
+                    if sharded_data_ids and not sharded:
+                        # A replicated differentiable input under sharded
+                        # data would receive per-device PARTIAL grads with
+                        # no sync — unsound; fall back to replicated data.
+                        rg_unsharded_input = True
                     rg_input_pos += 1
+
+        if rg_unsharded_input:
+            return self._compile(args, kwargs, _force_replicated_data=True)
+
+        # Batch-taint analysis: proxies whose value derives from a
+        # batch-sharded input differ per device; everything else (params
+        # post-synchronize, constants) is replicated.
+        tainted: set[str] = set(sharded_data_argnames)
+        if tainted:
+            for b in comp.bound_symbols:
+                if any(isinstance(a, TensorProxy) and a.name in tainted for a in b.flat_proxy_args):
+                    for o in b.flat_proxy_outs:
+                        tainted.add(o.name)
 
         executors = resolve_executors(self._jit_options.get("executors"))
         needs_grad = any(a.requires_grad for a in comp.args if isinstance(a, TensorProxy))
 
-        if not needs_grad:
-            ex = transform_for_execution(comp, executors)
-            return {"fwd": jax.jit(ex.python_callable()), "bwd": None, "traces": [comp, ex]}
+        from jax.sharding import PartitionSpec as _P
 
-        fw, bw = forward_and_backward_from_trace(comp)
-        if self._jit_options.get("rematerialize", True):
-            from thunder_tpu.transforms.rematerialization import rematerialize_forward_and_backward
+        class _FallbackReplicated(Exception):
+            pass
 
-            fw, bw = rematerialize_forward_and_backward(fw, bw)
-        fw_ex = transform_for_execution(fw, executors)
-        bw_ex = transform_for_execution(bw, executors)
+        def dim0_spec(ndim: int):
+            return _P(dist_axis, *([None] * (ndim - 1)))
+
+        def spec_of(p) -> Any:
+            """PartitionSpec for a trace arg: fsdp-sharded params and
+            batch-sharded data are dim-0 over the dist axis; everything
+            else replicated."""
+            q = qual_of_argname.get(p.name)
+            if (q is not None and q in sharded_quals) or p.name in sharded_data_argnames:
+                return dim0_spec(p.ndim)
+            return _P()
+
+        def out_spec_of(p) -> Any:
+            """User-visible output: batch-tainted tensors reassemble along
+            dim 0 (the batch dim by convention); scalars can't — fall back
+            to replicated data for the whole compile."""
+            if isinstance(p, TensorProxy) and p.name in tainted:
+                if p.ndim == 0:
+                    raise _FallbackReplicated
+                return dim0_spec(p.ndim)
+            return _P()
+
+        def saved_spec_of(p) -> Any:
+            """Saved-for-backward is a private fw→bw pipe: ANY dim-0 spec
+            round-trips exactly (out concatenates locals, bw in splits them
+            back), and keeping it sharded avoids a gather at the jit
+            boundary. Scalars must be genuinely replicated."""
+            if not isinstance(p, TensorProxy) or p.ndim == 0:
+                if isinstance(p, TensorProxy) and p.name in tainted:
+                    raise _FallbackReplicated
+                return _P()
+            return dim0_spec(p.ndim)
+
+        def stage(trc, out_specs, in_specs=None) -> Any:
+            """jax.jit for single-device; shard_map over the mesh when a
+            ddp/fsdp config is active (collectives in the trace reference
+            the mesh axis by name)."""
+            if dist_axis is None:
+                return jax.jit(trc.python_callable())
+            from thunder_tpu.distributed.runtime import shard_map_callable
+
+            if in_specs is None:
+                in_specs = tuple(spec_of(a) for a in trc.args)
+            return shard_map_callable(trc.python_callable(), self._dist["mesh"], in_specs, out_specs)
+
+        try:
+            if not needs_grad:
+                ex = transform_for_execution(comp, executors)
+                out_specs = tree_map(out_spec_of, comp.output) if dist_axis else None
+                return {"fwd": stage(ex, out_specs), "bwd": None, "traces": [comp, ex]}
+
+            fw, bw = forward_and_backward_from_trace(comp)
+            if self._jit_options.get("rematerialize", True):
+                from thunder_tpu.transforms.rematerialization import rematerialize_forward_and_backward
+
+                fw, bw = rematerialize_forward_and_backward(fw, bw)
+            fw_ex = transform_for_execution(fw, executors)
+            bw_ex = transform_for_execution(bw, executors)
+
+            if dist_axis is None:
+                fw_out_specs = bw_out_specs = bw_in_specs = None
+            else:
+                saved = tuple(fw.output[1])
+                saved_specs = tuple(saved_spec_of(s) for s in saved)
+                fw_out_specs = (tree_map(out_spec_of, comp.output), saved_specs)
+                flat_out, _ = tree_flatten(comp.output)
+                out_tensors = [o for o in flat_out if isinstance(o, TensorProxy)]
+                # bw args = saved + one cotangent per fw out tensor; each
+                # cotangent mirrors its output's spec.
+                bw_in_specs = saved_specs + tuple(out_spec_of(o) for o in out_tensors)
+                ndim_of = {q: trace_params[q].ndim for q in sharded_quals}
+                rg_input_proxies = [
+                    a for a in comp.args
+                    if a.requires_grad and qual_of_argname.get(a.name) is None
+                ]
+                bw_out_specs = []
+                for kind, which in wrt_kinds:
+                    if kind == "param":
+                        bw_out_specs.append(
+                            dim0_spec(ndim_of[which]) if which in sharded_quals else _P()
+                        )
+                    else:
+                        p = rg_input_proxies[which]
+                        bw_out_specs.append(
+                            dim0_spec(p.ndim) if input_grad_sharded[which] else _P()
+                        )
+                bw_out_specs = tuple(bw_out_specs)
+        except _FallbackReplicated:
+            return self._compile(args, kwargs, _force_replicated_data=True)
+
         return {
-            "fwd": jax.jit(fw_ex.python_callable()),
-            "bwd": jax.jit(bw_ex.python_callable()),
+            "fwd": stage(fw_ex, fw_out_specs),
+            "bwd": stage(bw_ex, bw_out_specs, bw_in_specs),
             "wrt_kinds": wrt_kinds,
             "traces": [comp, fw_ex, bw_ex],
         }
