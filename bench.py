@@ -1,10 +1,14 @@
 """Benchmark entry point: one JSON line for the driver.
 
-Workload: the reference's headline single-device benchmark — open_llama_3b
-single forward at B=10 × T=2048, bf16 (reference:
-examples/lit-gpt/1_forward.py, thunder on A100-40GB: 1.27 s — BASELINE.md).
-Here the model runs through the full trace pipeline (functional frontend →
-prim trace → claiming → XLA staging) on one TPU chip.
+Primary workload (the north-star half): the reference's single-device
+TRAINING benchmark — open_llama_3b, bf16-true, SGD(wd=0.1, no momentum),
+micro-batch 2 × T=2048, 45 timed iters (reference: examples/lit-gpt/train.py,
+thunder on A100-40GB: 21.9 s / 45 iters = 0.4867 s/iter — BASELINE.md).
+The full step (fw + bw + SGD update) stages as ONE XLA executable with
+donated params; min-cut rematerialization bounds saved activations.
+
+Also reported: the forward-only headline (open_llama_3b fwd B=10×T=2048,
+reference thunder: 1.27 s).
 
 vs_baseline = reference_thunder_time / our_time (>1 ⇒ faster than the
 reference's thunder+nvFuser on A100).
@@ -18,103 +22,183 @@ import time
 
 import numpy as np
 
-REF_THUNDER_A100_S = 1.27  # examples/lit-gpt/README.md:18-22
-B, T = 10, 2048
+REF_TRAIN_ITER_A100_S = 21.9 / 45  # examples/lit-gpt/README.md:35-39
+REF_FWD_A100_S = 1.27  # examples/lit-gpt/README.md:18-22
+TRAIN_B, TRAIN_T = 2, 2048  # reference train.py: micro_batch_size=2
+FWD_B, FWD_T = 10, 2048
+N_PARAMS = 3.43e9  # open_llama_3b
+LR, WD = 6e-4, 0.1  # reference train.py
 
 
-def build(cfg_name: str, batch: int, seq: int):
+def _trace_claim(fn, args):
     from thunder_tpu.api import trace_program
+    from thunder_tpu.transforms.common import dce
+
+    _, comp = trace_program(fn, args, {})
+    return dce(comp)
+
+
+def build_forward(cfg_name: str, batch: int, seq: int):
     from thunder_tpu.core import dtypes
     from thunder_tpu.core.pytree import tree_flatten
     from thunder_tpu.executors.passes import transform_for_execution
     from thunder_tpu.extend import resolve_executors
     from thunder_tpu.models import gpt as m
-    from thunder_tpu.transforms.common import dce
 
     cfg = m.name_to_config(cfg_name)
     params = m.init_params(cfg, dtype=dtypes.bfloat16, device_init=True, seed=0)
     idx = np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
 
-    fn = lambda p, i: m.forward(p, i, cfg)  # noqa: E731
-    _, comp = trace_program(fn, (params, idx), {})
-    extrace = transform_for_execution(dce(comp), resolve_executors(None))
-    flat_fn = extrace.python_callable()
+    comp = _trace_claim(lambda p, i: m.forward(p, i, cfg), (params, idx))
+    extrace = transform_for_execution(comp, resolve_executors(None))
     flat_args, _ = tree_flatten(((params, idx), {}))
-    return flat_fn, flat_args
+    return extrace.python_callable(), flat_args
 
 
-def main() -> None:
+def build_train(cfg_name: str, batch: int, seq: int):
+    """One full training step (fw+bw+SGD) as a single donated-params XLA
+    executable, matching the reference's train.py workload: bf16-true,
+    torch.optim.SGD(lr=6e-4, weight_decay=0.1) — no momentum state, which
+    is what lets the 3B model train on a 16 GB chip."""
+    import jax
+    import jax.numpy as jnp
+
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.core.pytree import tree_flatten
+    from thunder_tpu.executors.passes import transform_for_execution
+    from thunder_tpu.extend import resolve_executors
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.transforms.autodiff import forward_and_backward_from_trace
+    from thunder_tpu.transforms.rematerialization import rematerialize_forward_and_backward
+
+    cfg = m.name_to_config(cfg_name)
+    params = m.init_params(cfg, dtype=dtypes.bfloat16, device_init=True, seed=0)
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+
+    comp = _trace_claim(lambda p, i, t: m.loss_fn(p, i, t, cfg), (params, idx, tgt))
+    fw, bw = forward_and_backward_from_trace(comp)
+    fw, bw = rematerialize_forward_and_backward(fw, bw)
+    executors = resolve_executors(None)
+    fw_fn = transform_for_execution(fw, executors).python_callable()
+    bw_fn = transform_for_execution(bw, executors).python_callable()
+
+    flat_params, _ = tree_flatten((params,))
+    n_p = len(flat_params)
+
+    def step(flat_p, i, t):
+        loss, saved = fw_fn(*flat_p, i, t)
+        ct = jnp.ones((), dtype=loss.dtype)
+        grads = bw_fn(*saved, ct)
+        # torch.optim.SGD semantics: g += wd*p, p -= lr*g (bf16-true).
+        new_p = [
+            (p - LR * (g.astype(p.dtype) + WD * p)).astype(p.dtype)
+            for p, g in zip(flat_p, grads)
+        ]
+        return new_p, loss
+
+    jfn = jax.jit(step, donate_argnums=(0,))
+    return jfn, flat_params, idx, tgt
+
+
+def _bench_forward():
     import jax
 
-    # With the flash-attention executor claiming SDPA there is no (B,H,T,T)
-    # score materialization and the full B=10 fits on a 16 GB chip.
-    micro = B
-
-    t_build0 = time.perf_counter()
-    flat_fn, flat_args = build("open_llama_3b", micro, T)
+    t0 = time.perf_counter()
+    flat_fn, flat_args = build_forward("open_llama_3b", FWD_B, FWD_T)
     jfn = jax.jit(flat_fn)
-    build_s = time.perf_counter() - t_build0
-
-    n_chunks = (B + micro - 1) // micro
+    build_s = time.perf_counter() - t0
 
     def run():
-        # A scalar host read forces completion — block_until_ready is not
-        # sufficient on remote/async backends.
-        outs = [jfn(*flat_args) for _ in range(n_chunks)]
-        return float(np.asarray(outs[-1][0, 0, 0]))
+        out = jfn(*flat_args)
+        return float(np.asarray(out[0, 0, 0]))
 
-    # Warmup (compile)
-    t_c0 = time.perf_counter()
+    t0 = time.perf_counter()
     run()
-    compile_s = time.perf_counter() - t_c0
-
+    compile_s = time.perf_counter() - t0
     times = []
     for _ in range(5):
         t0 = time.perf_counter()
         run()
         times.append(time.perf_counter() - t0)
     med = sorted(times)[len(times) // 2]
+    print(f"# fwd trace+claim: {build_s:.1f}s compile: {compile_s:.1f}s runs: {[f'{t:.3f}' for t in times]}",
+          file=sys.stderr)
+    return med
 
-    # MFU context: fwd FLOPs ≈ 2·N_params·tokens. The reference ran on
-    # A100-SXM4 (312 bf16 TFLOP/s peak); this chip's peak differs, so MFU is
-    # the hardware-neutral comparison.
-    n_params = 3.43e9  # open_llama_3b
-    flops = 2.0 * n_params * B * T
-    our_tflops = flops / med / 1e12
-    peak = {"v5e": 197.0, "v5p": 459.0}.get(_tpu_gen(), 197.0)
-    ref_tflops = flops / REF_THUNDER_A100_S / 1e12
 
+def _bench_train():
+    t0 = time.perf_counter()
+    jfn, flat_params, idx, tgt = build_train("open_llama_3b", TRAIN_B, TRAIN_T)
+    build_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    flat_params, loss = jfn(flat_params, idx, tgt)
+    loss0 = float(np.asarray(loss))
+    compile_s = time.perf_counter() - t0
+
+    # Reference protocol: 45 timed iters after warmup (train.py:60-67).
+    times = []
+    for _ in range(45):
+        t0 = time.perf_counter()
+        flat_params, loss = jfn(flat_params, idx, tgt)
+        _ = float(np.asarray(loss))  # host read forces completion
+        times.append(time.perf_counter() - t0)
+    total = sum(times)
+    med = sorted(times)[len(times) // 2]
+    loss_last = float(np.asarray(loss))
     print(
-        f"# trace+claim: {build_s:.1f}s  compile: {compile_s:.1f}s  "
-        f"runs: {[f'{t:.3f}' for t in times]}  tokens/s: {B * T / med:,.0f}",
+        f"# train trace+claim: {build_s:.1f}s compile: {compile_s:.1f}s "
+        f"45 iters: {total:.2f}s median iter: {med:.4f}s loss {loss0:.3f}->{loss_last:.3f}",
         file=sys.stderr,
     )
-    print(json.dumps({
-        "metric": "open_llama_3b_fwd_b10_t2048",
-        "value": round(med, 4),
-        "unit": "s",
-        "vs_baseline": round(REF_THUNDER_A100_S / med, 3),
-        "tokens_per_sec": round(B * T / med),
-        "mfu": round(our_tflops / peak, 3),
-        "baseline_mfu_a100": round(ref_tflops / 312.0, 3),
-    }))
+    assert np.isfinite(loss_last) and loss_last < loss0, (loss0, loss_last)
+    return med, total
 
 
-def _tpu_gen() -> str:
+def _tpu_peak_tflops() -> float:
     import os
 
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
-    if gen:
-        return gen
-    try:
-        import jax
+    if not gen:
+        try:
+            import jax
 
-        kind = jax.devices()[0].device_kind.lower()
-        if "v5p" in kind or "v5 p" in kind:
-            return "v5p"
-    except Exception:
-        pass
-    return "v5e"
+            kind = jax.devices()[0].device_kind.lower()
+            gen = "v5p" if ("v5p" in kind or "v5 p" in kind) else "v5e"
+        except Exception:
+            gen = "v5e"
+    return {"v5e": 197.0, "v5p": 459.0}.get(gen, 197.0)
+
+
+def main() -> None:
+    fwd_med = _bench_forward()
+    train_med, train_total = _bench_train()
+
+    peak = _tpu_peak_tflops()
+    fwd_flops = 2.0 * N_PARAMS * FWD_B * FWD_T
+    train_flops = 6.0 * N_PARAMS * TRAIN_B * TRAIN_T
+    train_mfu = train_flops / train_med / 1e12 / peak
+    fwd_mfu = fwd_flops / fwd_med / 1e12 / peak
+    # Hardware-neutral comparison: the reference's training MFU on its A100
+    # (312 bf16 TFLOP/s peak) from the same FLOP model.
+    ref_train_mfu = train_flops / REF_TRAIN_ITER_A100_S / 1e12 / 312.0
+
+    print(json.dumps({
+        "metric": "open_llama_3b_train_iter_b2_t2048",
+        "value": round(train_med, 4),
+        "unit": "s",
+        "vs_baseline": round(REF_TRAIN_ITER_A100_S / train_med, 3),
+        "train_mfu_vs_ref_mfu": round(train_mfu / ref_train_mfu, 3),
+        "ref_train_mfu_a100": round(ref_train_mfu, 3),
+        "train_45iters_s": round(train_total, 2),
+        "train_tokens_per_sec": round(TRAIN_B * TRAIN_T / train_med),
+        "train_mfu": round(train_mfu, 3),
+        "fwd_b10_s": round(fwd_med, 4),
+        "fwd_vs_baseline": round(REF_FWD_A100_S / fwd_med, 3),
+        "fwd_mfu": round(fwd_mfu, 3),
+    }))
 
 
 if __name__ == "__main__":

@@ -113,19 +113,25 @@ def _attach_dist_config(model, cfg: dict):
     return model
 
 
-def ddp(model_or_params, *, mesh=None, axis: str = "dp", broadcast_from: Optional[int] = 0):
+def ddp(model_or_params, *, mesh=None, axis: str = "dp", broadcast_from: Optional[int] = 0,
+        shard_data: bool = True):
     """Mark a model/params replicated for data-parallel training
     (reference: `ddp:88`).
 
     - torch ``nn.Module`` / ``ThunderModule``: tags the module; at trace time
       every param passes through `synchronize` (identity forward, pre-scaled
       all-reduce backward) and the traces stage under shard_map on ``mesh``.
-      ``broadcast_from`` replicates that rank's initial params to the group
-      (reference `__init__.py:150-163`); pass None to skip.
+      ``broadcast_from`` exists for reference API parity (`__init__.py:150-163`);
+      in this single-controller runtime every device is initialized from the
+      one host copy, so root-rank broadcast is satisfied by construction and
+      the value is accepted but has no further effect.
+      ``shard_data=False`` disables batch sharding of data inputs (use when
+      dim 0 of an input is not the batch dim).
     - params pytree of proxies: marks `dist_parallel_type` (trace-level IR).
     """
     if _is_torch_module(model_or_params) or _is_thunder_module(model_or_params):
-        cfg = {"mode": "ddp", "mesh": mesh, "axis": axis, "broadcast_from": broadcast_from}
+        cfg = {"mode": "ddp", "mesh": mesh, "axis": axis, "broadcast_from": broadcast_from,
+               "shard_data": shard_data}
         return _attach_dist_config(model_or_params, cfg)
 
     from thunder_tpu.core.pytree import tree_map
@@ -146,6 +152,7 @@ def fsdp(
     sharding_strategy: FSDPType = FSDPType.ZERO3,
     bucketing_strategy: FSDPBucketingStrategy = FSDPBucketingStrategy.NONE,
     axis: str = "fsdp",
+    shard_data: bool = True,
 ):
     """Mark a model/params fully-sharded (reference: `fsdp:303`,
     dim-0 `_shard_param:406`).
@@ -164,6 +171,7 @@ def fsdp(
             "axis": axis,
             "fsdp_type": sharding_strategy,
             "bucketing": bucketing_strategy,
+            "shard_data": shard_data,
         }
         return _attach_dist_config(model_or_params, cfg)
 

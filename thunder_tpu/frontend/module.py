@@ -182,6 +182,10 @@ class ThunderModule:
     def configure_distributed(self, cfg: Optional[dict]) -> None:
         """Install a ddp/fsdp config ({mode, mesh, axis, ...}) after jit;
         clears compiled entries and re-bridges params onto the mesh."""
+        if cfg is not None:
+            from thunder_tpu.distributed import _validate_dist_cfg
+
+            _validate_dist_cfg(cfg)  # defaults the mesh, checks the axis
         self._dist = cfg
         self._cache.clear()
         self.resync_params()
