@@ -154,6 +154,45 @@ nextafter = _make_elementwise_binary("nextafter", prims.nextafter, tpk=_K.INT_TO
 pow = _make_elementwise_binary("pow", prims.pow_prim, method="pow")
 remainder = _make_elementwise_binary("remainder", prims.remainder, method="remainder")
 sub = _make_elementwise_binary("sub", prims.sub, method="sub")
+copysign = _make_elementwise_binary("copysign", prims.copysign, tpk=_K.INT_TO_FLOAT, method="copysign")
+zeta = _make_elementwise_binary("zeta", prims.zeta, tpk=_K.INT_TO_FLOAT)
+mod = remainder  # reference clang alias (clang/__init__.py `mod`)
+
+
+@clangop()
+def polygamma(n: int, a):
+    check(isinstance(n, (int, NumberProxy)) and int(pyval(n)) >= 0, lambda: f"polygamma order must be a non-negative int, got {n}")
+    computation_dtype, result_dtype = utils.elementwise_type_promotion(a, type_promotion_kind=_K.INT_TO_FLOAT)
+    if isinstance(a, TensorProxy):
+        a = maybe_convert_to_dtype(a, computation_dtype)
+    return prims.polygamma(int(pyval(n)), a)
+
+
+@clangop(method_name="logical_and")
+def logical_and(a, b):
+    return bitwise_and(ne(a, 0) if not _is_bool(a) else a, ne(b, 0) if not _is_bool(b) else b)
+
+
+@clangop(method_name="logical_or")
+def logical_or(a, b):
+    return bitwise_or(ne(a, 0) if not _is_bool(a) else a, ne(b, 0) if not _is_bool(b) else b)
+
+
+def _is_bool(x) -> bool:
+    return isinstance(x, TensorProxy) and dtypes.is_boolean_dtype(x.dtype) or isinstance(x, bool)
+
+
+@clangop(method_name="real")
+def real(a):
+    """Real part; identity on real-dtype tensors (no op emitted)."""
+    if isinstance(a, TensorProxy) and not dtypes.is_complex_dtype(a.dtype):
+        return a
+    return prims.real(a)
+
+
+@clangop()
+def imag(a):
+    return prims.imag(a)
 
 
 @clangop(method_name="true_divide")

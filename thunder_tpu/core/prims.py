@@ -94,6 +94,7 @@ class PrimIDs(enum.Enum):
     RANDN = enum.auto()
     UNIFORM_KEYED = enum.auto()
     RANDN_KEYED = enum.auto()
+    UNIFORM_PHILOX = enum.auto()
     TENSOR_FROM_SEQUENCE = enum.auto()
     # Shape ops
     BROADCAST_IN_DIM = enum.auto()
@@ -153,6 +154,8 @@ class PrimIDs(enum.Enum):
     TAN = enum.auto()
     TANH = enum.auto()
     TRUNC = enum.auto()
+    REAL = enum.auto()
+    IMAG = enum.auto()
     # Elementwise binary
     ADD = enum.auto()
     ATAN2 = enum.auto()
@@ -176,6 +179,9 @@ class PrimIDs(enum.Enum):
     POW = enum.auto()
     REMAINDER = enum.auto()
     SUB = enum.auto()
+    COPYSIGN = enum.auto()
+    ZETA = enum.auto()
+    POLYGAMMA = enum.auto()
     # Conditional
     WHERE = enum.auto()
     # Reductions
@@ -193,6 +199,8 @@ class PrimIDs(enum.Enum):
     CONVOLUTION = enum.auto()
     EMBEDDING = enum.auto()
     EMBEDDING_BACKWARD = enum.auto()
+    POOL = enum.auto()
+    POOL_BWD = enum.auto()
 
 
 _prims_by_id: dict[PrimIDs, Symbol] = {}
@@ -994,6 +1002,8 @@ sqrt = _make_elementwise_unary(PrimIDs.SQRT, "sqrt", supported=_float_kinds)
 tan = _make_elementwise_unary(PrimIDs.TAN, "tan", supported=_float_kinds)
 tanh = _make_elementwise_unary(PrimIDs.TANH, "tanh", supported=_float_kinds)
 trunc = _make_elementwise_unary(PrimIDs.TRUNC, "trunc", supported=("float",))
+real = _make_elementwise_unary(PrimIDs.REAL, "real", tpk=_K.COMPLEX_TO_FLOAT, supported=_float_kinds)
+imag = _make_elementwise_unary(PrimIDs.IMAG, "imag", tpk=_K.COMPLEX_TO_FLOAT, supported=("complex",))
 
 
 def _elementwise_binary_meta_factory(name: str, *, type_promotion_kind):
@@ -1049,6 +1059,17 @@ nextafter = _make_elementwise_binary(PrimIDs.NEXTAFTER, "nextafter")
 pow_prim = _make_elementwise_binary(PrimIDs.POW, "pow")
 remainder = _make_elementwise_binary(PrimIDs.REMAINDER, "remainder")
 sub = _make_elementwise_binary(PrimIDs.SUB, "sub")
+copysign = _make_elementwise_binary(PrimIDs.COPYSIGN, "copysign")
+zeta = _make_elementwise_binary(PrimIDs.ZETA, "zeta")
+
+
+def _polygamma_meta(n: int, a: TensorProxy) -> TensorProxy:
+    check(isinstance(a, TensorProxy), "polygamma expects a tensor")
+    check(dtypes.is_float_dtype(a.dtype), "polygamma requires a float tensor")
+    return TensorProxy(like=a)
+
+
+polygamma = make_prim(PrimIDs.POLYGAMMA, "polygamma", _polygamma_meta, tags=(OpTags.ELEMENTWISE_UNARY_OP,))
 
 
 def _where_meta(pred, a, b):
@@ -1214,6 +1235,52 @@ def _embedding_backward_meta(grad: TensorProxy, indices: TensorProxy, num_weight
 
 
 embedding_backward = make_prim(PrimIDs.EMBEDDING_BACKWARD, "embedding_backward", _embedding_backward_meta)
+
+
+def _pool_out_spatial(in_sizes, window, strides, padding):
+    out = []
+    for s, w, st, (lo, hi) in zip(in_sizes, window, strides, padding):
+        out.append((s + lo + hi - w) // st + 1)
+    return tuple(out)
+
+
+def _pool_meta(
+    a: TensorProxy, kind: str, window: Sequence[int], strides: Sequence[int],
+    padding: Sequence[tuple],
+) -> TensorProxy:
+    """Window reduction over the trailing len(window) dims of (N, C, *spatial)
+    input — lowers to XLA reduce_window, the native TPU pooling op
+    (reference seat: the torch max/avg_poolNd ATen calls,
+    thunder/torch/__init__.py max_pool1d..avg_pool3d)."""
+    check(kind in ("max", "avg"), lambda: f"Unknown pool kind {kind}")
+    k = len(window)
+    check(a.ndim >= k + 1, "pool input rank too small for window")
+    spatial = _pool_out_spatial(a.shape[-k:], window, strides, padding)
+    return TensorProxy(like=a, shape=tuple(a.shape[:-k]) + spatial)
+
+
+pool = make_prim(PrimIDs.POOL, "pool", _pool_meta, tags=(OpTags.REDUCTION_OP,))
+
+
+def _pool_bwd_meta(g: TensorProxy, a: TensorProxy, kind: str, window, strides, padding) -> TensorProxy:
+    return TensorProxy(like=a)
+
+
+pool_bwd = make_prim(PrimIDs.POOL_BWD, "pool_bwd", _pool_bwd_meta)
+
+
+def _uniform_philox_meta(
+    shape: Sequence[int], minval: Number, maxval: Number, *, seed, offset,
+    device: devices.Device, dtype: dtypes.dtype,
+) -> TensorProxy:
+    """Counter-based (stateless) uniform: same (seed, offset) → same bits
+    (reference: thunder/core/prims.py `uniform_philox:142`). Pure given its
+    args, so it stages under jit without the RNG functionalization pass."""
+    check(dtypes.is_float_dtype(dtype), "uniform_philox requires a float dtype")
+    return TensorProxy(shape=tuple(shape), device=devices.to_device(device), dtype=dtype)
+
+
+uniform_philox = make_prim(PrimIDs.UNIFORM_PHILOX, "uniform_philox", _uniform_philox_meta)
 
 
 # Generated code prints prims qualified as ``prims.<name>``.

@@ -226,6 +226,8 @@ _unary_table = {
     PrimIDs.TAN: jnp.tan,
     PrimIDs.TANH: jnp.tanh,
     PrimIDs.TRUNC: jnp.trunc,
+    PrimIDs.REAL: jnp.real,
+    PrimIDs.IMAG: jnp.imag,
 }
 for pid, fn in _unary_table.items():
     _reg(pid, fn)
@@ -272,6 +274,8 @@ _binary_table = {
     PrimIDs.POW: jnp.power,
     PrimIDs.REMAINDER: jnp.remainder,
     PrimIDs.SUB: jnp.subtract,
+    PrimIDs.COPYSIGN: jnp.copysign,
+    PrimIDs.ZETA: lambda a, b: jsp.zeta(a, b),
 }
 for pid, fn in _binary_table.items():
     _reg(pid, fn)
@@ -389,3 +393,36 @@ def _embedding_backward(grad, idx, num_weights, embed_dim):
 
 
 _reg(PrimIDs.EMBEDDING_BACKWARD, _embedding_backward)
+_reg(PrimIDs.POLYGAMMA, lambda n, a: jsp.polygamma(n, a))
+
+
+def _pool_fwd_fn(a, kind, window, strides, padding):
+    """reduce_window over the trailing len(window) dims — XLA's native
+    pooling; avg divides by the full window size (count_include_pad=True,
+    torch's default)."""
+    k = len(window)
+    full_window = (1,) * (a.ndim - k) + tuple(window)
+    full_strides = (1,) * (a.ndim - k) + tuple(strides)
+    full_pad = ((0, 0),) * (a.ndim - k) + tuple((int(lo), int(hi)) for lo, hi in padding)
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+        return lax.reduce_window(a, jnp.asarray(init, a.dtype), lax.max, full_window, full_strides, full_pad)
+    s = lax.reduce_window(a, jnp.asarray(0, a.dtype), lax.add, full_window, full_strides, full_pad)
+    return s / math.prod(window)
+
+
+def _pool_bwd_fn(g, a, kind, window, strides, padding):
+    _, vjp = jax.vjp(lambda x: _pool_fwd_fn(x, kind, window, strides, padding), a)
+    return vjp(g)[0]
+
+
+_reg(PrimIDs.POOL, _pool_fwd_fn)
+_reg(PrimIDs.POOL_BWD, _pool_bwd_fn)
+
+
+def _uniform_philox(shape, minval, maxval, *, seed, offset, device, dtype):
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), offset)
+    return jax.random.uniform(key, tuple(shape), dtype=_jd(dtype), minval=minval, maxval=maxval)
+
+
+_reg(PrimIDs.UNIFORM_PHILOX, _uniform_philox)

@@ -165,7 +165,22 @@ register_nondiff(
     PrimIDs.BITWISE_LEFT_SHIFT,
     PrimIDs.BITWISE_RIGHT_SHIFT,
     PrimIDs.EMBEDDING_BACKWARD,
+    PrimIDs.UNIFORM_PHILOX,
+    PrimIDs.POOL_BWD,
+    PrimIDs.IMAG,
 )
+
+
+@register_vjp(PrimIDs.POLYGAMMA)
+def _polygamma_vjp(bsym, g):
+    n, a = bsym.args
+    return (None, clang.mul(g, prims.polygamma(int(n) + 1, a)))
+
+
+@register_vjp(PrimIDs.POOL)
+def _pool_vjp(bsym, g):
+    a, kind, window, strides, padding = bsym.args
+    return (prims.pool_bwd(g, a, kind, window, strides, padding), None, None, None, None)
 
 
 # =============================================================================
@@ -215,6 +230,14 @@ _unary_vjps = {
         clang.mul(g, clang.mul(_SQRT_PI_INV_2, clang.exp(clang.neg(clang.mul(a, a)))))
     ),
     PrimIDs.LGAMMA: lambda a, out, g: clang.mul(g, clang.digamma(a)),
+    # d/dx erfinv(x) = sqrt(pi)/2 * exp(erfinv(x)^2)
+    PrimIDs.ERFINV: lambda a, out, g: clang.mul(
+        g, clang.mul(math.sqrt(math.pi) / 2.0, clang.exp(clang.mul(out, out)))
+    ),
+    # d/dx digamma(x) = polygamma(1, x)
+    PrimIDs.DIGAMMA: lambda a, out, g: clang.mul(g, prims.polygamma(1, a)),
+    # real() on a float tensor is the identity (complex autodiff unsupported).
+    PrimIDs.REAL: lambda a, out, g: g,
 }
 
 for _pid, _fn in _unary_vjps.items():
@@ -272,6 +295,18 @@ _binary_vjps = {
         lambda a, b, out, g: clang.neg(clang.mul(g, clang.floor(clang.true_divide(a, b)))),
     ),
     PrimIDs.NEXTAFTER: (lambda a, b, out, g: g, lambda a, b, out, g: None),
+    # copysign(a, b) = |a|*sgn(b): d/da = sign(a)*sgn(b); b only supplies sign.
+    PrimIDs.COPYSIGN: (
+        lambda a, b, out, g: clang.mul(
+            g, clang.mul(clang.sign(a), clang.where(clang.signbit(b), -1.0, 1.0))
+        ),
+        lambda a, b, out, g: None,
+    ),
+    # d/dx zeta(s, x) = -s * zeta(s+1, x); grad wrt s undefined (torch parity).
+    PrimIDs.ZETA: (
+        lambda a, b, out, g: None,
+        lambda a, b, out, g: clang.mul(g, clang.mul(clang.neg(a), prims.zeta(clang.add(a, 1.0), b))),
+    ),
 }
 
 for _pid, (_fa, _fb) in _binary_vjps.items():
