@@ -452,6 +452,62 @@ def scenario_long_context_train():
     print("long_context_train OK", float(l1))
 
 
+def scenario_batch_reduced_output():
+    """ADVICE r2 regressions: (1) a module output that reduces over the
+    batch dim (x.mean(dim=0)) under sharded data must not be reassembled
+    from per-device partial reductions — the compile falls back to
+    replicated data and returns the correct full-batch value; (2) an
+    ndim>=2 aux input whose dim 0 differs from the batch (a (T,T) mask)
+    must not be silently batch-sharded."""
+    import torch
+
+    import thunder_tpu
+    from thunder_tpu.distributed import ddp
+    from thunder_tpu.parallel import make_mesh
+
+    class Reducer(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = torch.nn.Linear(4, 4, bias=False)
+
+        def forward(self, x):
+            return self.lin(x).mean(dim=0)
+
+    torch.manual_seed(0)
+    m = Reducer()
+    inp = torch.randn(32, 4)
+    ref = m(inp).detach().numpy()
+
+    tm = thunder_tpu.jit(ddp(Reducer(), mesh=make_mesh(dp=8)))
+    tm._module.load_state_dict(m.state_dict())
+    tm.resync_params()
+    got = tm(inp)
+    assert tuple(got.shape) == (4,), got.shape
+    np.testing.assert_allclose(got.detach().numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    class Masked(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = torch.nn.Linear(16, 16, bias=False)
+
+        def forward(self, x, mask):
+            # mask is (T, T) with T == 16: divisible by 8 but NOT the batch
+            # size (24) — must stay replicated.
+            return self.lin(x) + mask.sum()
+
+    torch.manual_seed(1)
+    m2 = Masked()
+    x2 = torch.randn(24, 16)
+    mask = torch.randn(16, 16)
+    ref2 = m2(x2, mask).detach().numpy()
+    tm2 = thunder_tpu.jit(ddp(Masked(), mesh=make_mesh(dp=8)))
+    tm2._module.load_state_dict(m2.state_dict())
+    tm2.resync_params()
+    got2 = tm2(x2, mask)
+    np.testing.assert_allclose(got2.detach().numpy(), ref2, rtol=1e-4, atol=1e-5)
+    print("batch_reduced_output OK")
+
+
 if __name__ == "__main__":
     scenario = sys.argv[1]
     globals()[f"scenario_{scenario}"]()

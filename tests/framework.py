@@ -1,0 +1,130 @@
+"""Test-generation framework: the executor × dtype matrix.
+
+Reference parity: thunder/tests/framework.py — `TestExecutor` (:123) and the
+one-to-many `ops` decorator (:304) that *instantiates* a template into many
+real test functions injected into the caller's module scope (code-generated
+tests, not pytest.parametrize), one per OpInfo × executor × dtype.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+import torch
+
+
+class TestExecutor:
+    """A named executor list to compile with (reference: framework.py:123)."""
+
+    def __init__(self, name: str, executors: Optional[Sequence[str]]):
+        self.name = name
+        self.executors = executors
+
+    def jit(self, fn, **kwargs):
+        import thunder_tpu
+
+        if self.executors is not None:
+            kwargs.setdefault("executors", list(self.executors))
+        return thunder_tpu.jit(fn, **kwargs)
+
+    def grad(self, fn, **kwargs):
+        import thunder_tpu
+
+        if self.executors is not None:
+            kwargs.setdefault("executors", list(self.executors))
+        return thunder_tpu.grad(fn, **kwargs)
+
+
+jax_executor = TestExecutor("jax", None)  # default list (jax terminal)
+kernel_executor = TestExecutor("kernels", ["flash", "pallas", "jax"])
+quant_executor = TestExecutor("quant", ["quant", "jax"])
+
+_DEFAULT_EXECUTORS = (jax_executor,)
+
+
+# Forward-comparison tolerances per dtype (bf16 has ~3 decimal digits).
+_TOLS = {
+    torch.float32: dict(rtol=1.3e-5, atol=1e-5),
+    torch.float64: dict(rtol=1e-7, atol=1e-8),
+    torch.bfloat16: dict(rtol=1.6e-2, atol=1e-2),
+    torch.float16: dict(rtol=1e-3, atol=1e-3),
+    torch.int64: dict(rtol=0, atol=0),
+    torch.int32: dict(rtol=0, atol=0),
+    torch.bool: dict(rtol=0, atol=0),
+}
+
+
+def tolerances(dtype, opinfo=None) -> dict:
+    t = dict(_TOLS[dtype])
+    if opinfo is not None:
+        ov = opinfo.tol_overrides.get(dtype)
+        if ov:
+            t.update(ov)
+    return t
+
+
+def to_comparable(x):
+    """torch/jax/np value → float64/int64 numpy for comparison."""
+    if isinstance(x, torch.Tensor):
+        x = x.detach()
+        if x.dtype in (torch.bfloat16, torch.float16):
+            x = x.float()
+        return x.cpu().numpy()
+    return np.asarray(x)
+
+
+def assert_close(got, want, *, rtol, atol, err=""):
+    got_flat = got if isinstance(got, (tuple, list)) else (got,)
+    want_flat = want if isinstance(want, (tuple, list)) else (want,)
+    assert len(got_flat) == len(want_flat), f"{err}: output arity {len(got_flat)} != {len(want_flat)}"
+    for g, w in zip(got_flat, want_flat):
+        if g is None and w is None:
+            continue
+        g, w = to_comparable(g), to_comparable(w)
+        if w.dtype == np.bool_:
+            np.testing.assert_array_equal(g.astype(np.bool_), w, err_msg=err)
+        else:
+            np.testing.assert_allclose(
+                g.astype(np.float64), w.astype(np.float64), rtol=rtol, atol=atol, err_msg=err
+            )
+
+
+_DTYPE_SUFFIX = {
+    torch.float32: "f32",
+    torch.float64: "f64",
+    torch.bfloat16: "bf16",
+    torch.float16: "f16",
+    torch.int64: "i64",
+    torch.int32: "i32",
+    torch.bool: "bool",
+}
+
+
+def ops(opinfos, *, supported_dtypes=None, scope=None):
+    """Instantiate a test template per OpInfo × executor × dtype and inject
+    the generated functions into the calling module (reference:
+    framework.py `ops:304`)."""
+
+    def decorator(template: Callable):
+        module_dict = scope if scope is not None else sys._getframe(1).f_globals
+        for opinfo in opinfos:
+            dts = opinfo.dtypes
+            if supported_dtypes is not None:
+                dts = [d for d in dts if d in supported_dtypes]
+            for executor in opinfo.executors or _DEFAULT_EXECUTORS:
+                for dtype in dts:
+                    name = f"{template.__name__}_{opinfo.name}_{executor.name}_{_DTYPE_SUFFIX[dtype]}"
+
+                    def make(op=opinfo, ex=executor, dt=dtype):
+                        def test():
+                            return template(op, ex, dt)
+
+                        test.__name__ = name
+                        return test
+
+                    module_dict[name] = make()
+        return None
+
+    return decorator

@@ -1,0 +1,795 @@
+"""OpInfo database: per-op sample generators + torch-eager oracles.
+
+Reference parity: thunder/tests/opinfos.py (166 OpInfo instances with
+sample-input generators, error inputs, torch/JAX references, dtype domains),
+consumed by the generated matrix in tests/test_ops.py and tests/test_grad.py
+via framework.ops (reference framework.py:304).
+
+Every sample is a pytree of torch tensors/numbers; the op under test is the
+ltorch symbol, the oracle is the mirrored torch callable run eagerly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Iterable, Optional, Sequence
+
+import torch
+import torch.nn.functional as F
+
+import thunder_tpu.torch as ltorch
+
+FLOATS = (torch.float32, torch.bfloat16)
+FLOATS32 = (torch.float32,)
+INTS = (torch.int64,)
+BOOLS = (torch.bool,)
+FLOATS_INTS = FLOATS + INTS
+ALL = FLOATS + INTS + BOOLS
+
+
+class SampleInput:
+    def __init__(self, *args, **kwargs):
+        self.args = args
+        self.kwargs = kwargs
+
+    def __repr__(self):
+        return f"SampleInput(args={self.args}, kwargs={self.kwargs})"
+
+
+def make_tensor(shape, dtype, *, low=None, high=None, seed=0, requires_grad=False):
+    g = torch.Generator().manual_seed(seed + sum(shape, 1000) if shape else seed)
+    if dtype == torch.bool:
+        t = torch.rand(shape, generator=g) > 0.5
+    elif dtype in (torch.int64, torch.int32):
+        lo = -8 if low is None else int(low)
+        hi = 9 if high is None else int(high)
+        t = torch.randint(lo, hi, shape, generator=g, dtype=dtype)
+    else:
+        t = torch.randn(shape, generator=g, dtype=torch.float32)
+        if low is not None or high is not None:
+            lo = -3.0 if low is None else float(low)
+            hi = 3.0 if high is None else float(high)
+            t = lo + (hi - lo) * torch.rand(shape, generator=g)
+        t = t.to(dtype)
+    if requires_grad and t.is_floating_point():
+        t.requires_grad_(True)
+    return t
+
+
+class OpInfo:
+    def __init__(
+        self,
+        name: str,
+        op: Callable,
+        torch_ref: Callable,
+        sample_generator: Callable,
+        *,
+        dtypes: Sequence = FLOATS,
+        supports_grad: bool = True,
+        grad_generator: Optional[Callable] = None,
+        error_generator: Optional[Callable] = None,
+        executors=None,
+        tol_overrides: Optional[dict] = None,
+        singularity_low: Optional[float] = None,
+    ):
+        self.name = name
+        self.op = op
+        self.torch_ref = torch_ref
+        self.sample_generator = sample_generator
+        self.dtypes = tuple(dtypes)
+        self.supports_grad = supports_grad
+        self.grad_generator = grad_generator or sample_generator
+        self.error_generator = error_generator
+        self.executors = executors
+        self.tol_overrides = tol_overrides or {}
+
+    def samples(self, dtype) -> Iterable[SampleInput]:
+        return self.sample_generator(dtype)
+
+    def grad_samples(self, dtype) -> Iterable[SampleInput]:
+        return self.grad_generator(dtype)
+
+    def __repr__(self):
+        return f"OpInfo({self.name})"
+
+
+opinfos: list[OpInfo] = []
+
+
+def _add(info: OpInfo) -> OpInfo:
+    opinfos.append(info)
+    return info
+
+
+# =============================================================================
+# Elementwise unary
+# =============================================================================
+
+
+def _unary_samples(dtype, *, low=None, high=None):
+    yield SampleInput(make_tensor((4, 5), dtype, low=low, high=high, seed=1))
+    yield SampleInput(make_tensor((7,), dtype, low=low, high=high, seed=2))
+    yield SampleInput(make_tensor((2, 1, 3), dtype, low=low, high=high, seed=3))
+
+
+def unary_opinfo(name, *, torch_ref=None, dtypes=FLOATS, low=None, high=None,
+                 supports_grad=True, tol_overrides=None):
+    op = getattr(ltorch, name)
+    ref = torch_ref if torch_ref is not None else getattr(torch, name)
+    gen = functools.partial(_unary_samples, low=low, high=high)
+    return _add(OpInfo(name, op, ref, gen, dtypes=dtypes, supports_grad=supports_grad,
+                       tol_overrides=tol_overrides))
+
+
+unary_opinfo("abs", dtypes=FLOATS_INTS, supports_grad=False)
+unary_opinfo("acos", low=-0.9, high=0.9)
+unary_opinfo("acosh", low=1.2, high=4.0)
+unary_opinfo("asin", low=-0.9, high=0.9)
+unary_opinfo("asinh")
+unary_opinfo("atan")
+unary_opinfo("atanh", low=-0.9, high=0.9)
+unary_opinfo("ceil", supports_grad=False)
+unary_opinfo("cos")
+unary_opinfo("cosh", low=-3, high=3)
+unary_opinfo("digamma", low=0.2, high=4.0, dtypes=FLOATS32)
+unary_opinfo("erf")
+unary_opinfo("erfc")
+unary_opinfo("erfinv", low=-0.9, high=0.9, dtypes=FLOATS32)
+unary_opinfo("exp")
+unary_opinfo("exp2")
+unary_opinfo("expm1")
+unary_opinfo("floor", supports_grad=False)
+unary_opinfo("frac", supports_grad=False)
+unary_opinfo("lgamma", low=0.2, high=4.0, dtypes=FLOATS32)
+unary_opinfo("log", low=0.1, high=4.0)
+unary_opinfo("log10", low=0.1, high=4.0)
+unary_opinfo("log1p", low=-0.5, high=4.0)
+unary_opinfo("log2", low=0.1, high=4.0)
+unary_opinfo("logit", low=0.05, high=0.95, dtypes=FLOATS32)
+unary_opinfo("neg", dtypes=FLOATS_INTS)
+unary_opinfo("reciprocal", low=0.3, high=3.0)
+unary_opinfo("round", supports_grad=False)
+unary_opinfo("rsqrt", low=0.1, high=4.0)
+unary_opinfo("sigmoid", torch_ref=torch.sigmoid)
+unary_opinfo("sign", dtypes=FLOATS_INTS, supports_grad=False)
+unary_opinfo("signbit", dtypes=FLOATS_INTS, supports_grad=False)
+unary_opinfo("sin")
+unary_opinfo("sinc", dtypes=FLOATS32)
+unary_opinfo("sinh", low=-3, high=3)
+unary_opinfo("sqrt", low=0.1, high=4.0)
+unary_opinfo("square", dtypes=FLOATS_INTS)
+unary_opinfo("tan", low=-1.2, high=1.2)
+unary_opinfo("tanh")
+unary_opinfo("trunc", supports_grad=False)
+unary_opinfo("isfinite", supports_grad=False)
+unary_opinfo("isinf", supports_grad=False)
+unary_opinfo("isnan", supports_grad=False)
+unary_opinfo("rad2deg")
+unary_opinfo("deg2rad")
+unary_opinfo("logical_not", dtypes=ALL, supports_grad=False)
+unary_opinfo("bitwise_not", dtypes=INTS + BOOLS, supports_grad=False)
+
+
+def _nan_to_num_samples(dtype):
+    t = make_tensor((4, 5), dtype, seed=4)
+    if dtype.is_floating_point:
+        with torch.no_grad():
+            t = t.clone()
+            t.view(-1)[0] = float("nan")
+            t.view(-1)[1] = float("inf")
+            t.view(-1)[2] = float("-inf")
+    yield SampleInput(t)
+    yield SampleInput(t, nan=1.0, posinf=10.0, neginf=-10.0)
+
+
+_add(OpInfo("nan_to_num", ltorch.nan_to_num, torch.nan_to_num, _nan_to_num_samples,
+            supports_grad=False))
+
+
+def _polygamma_samples(dtype):
+    yield SampleInput(1, make_tensor((4, 5), dtype, low=0.3, high=4.0, seed=5))
+    yield SampleInput(2, make_tensor((6,), dtype, low=0.3, high=4.0, seed=6))
+
+
+_add(OpInfo("polygamma", ltorch.polygamma, torch.polygamma, _polygamma_samples,
+            dtypes=FLOATS32, supports_grad=False))
+
+
+# =============================================================================
+# Elementwise binary / ternary
+# =============================================================================
+
+
+def _binary_samples(dtype, *, low=None, high=None, rhs_low=None, rhs_high=None):
+    rl = low if rhs_low is None else rhs_low
+    rh = high if rhs_high is None else rhs_high
+    yield SampleInput(make_tensor((4, 5), dtype, low=low, high=high, seed=11),
+                      make_tensor((4, 5), dtype, low=rl, high=rh, seed=12))
+    yield SampleInput(make_tensor((3, 1, 4), dtype, low=low, high=high, seed=13),
+                      make_tensor((2, 4), dtype, low=rl, high=rh, seed=14))  # broadcasting
+    yield SampleInput(make_tensor((4,), dtype, low=low, high=high, seed=15), 1.5 if dtype.is_floating_point else 2)
+
+
+def binary_opinfo(name, *, torch_ref=None, dtypes=FLOATS, low=None, high=None,
+                  rhs_low=None, rhs_high=None, supports_grad=True, op=None, tol_overrides=None):
+    opfn = op if op is not None else getattr(ltorch, name)
+    ref = torch_ref if torch_ref is not None else getattr(torch, name)
+    gen = functools.partial(_binary_samples, low=low, high=high, rhs_low=rhs_low, rhs_high=rhs_high)
+    return _add(OpInfo(name, opfn, ref, gen, dtypes=dtypes, supports_grad=supports_grad,
+                       tol_overrides=tol_overrides))
+
+
+binary_opinfo("add", dtypes=FLOATS_INTS)
+binary_opinfo("sub", dtypes=FLOATS_INTS)
+binary_opinfo("rsub", dtypes=FLOATS_INTS)
+binary_opinfo("mul", dtypes=FLOATS_INTS)
+binary_opinfo("div", op=ltorch.div, dtypes=FLOATS_INTS, rhs_low=0.5, rhs_high=3.0)
+binary_opinfo("floor_divide", dtypes=FLOATS_INTS, rhs_low=1, rhs_high=5, supports_grad=False)
+binary_opinfo("fmod", rhs_low=0.5, rhs_high=3.0, supports_grad=False)
+binary_opinfo("remainder", dtypes=FLOATS_INTS, rhs_low=1, rhs_high=5, supports_grad=False)
+binary_opinfo("pow", low=0.2, high=2.0, rhs_low=-2.0, rhs_high=2.0)
+binary_opinfo("maximum", dtypes=FLOATS_INTS)
+binary_opinfo("minimum", dtypes=FLOATS_INTS)
+binary_opinfo("atan2")
+binary_opinfo("copysign")
+binary_opinfo("hypot")
+binary_opinfo("logaddexp", tol_overrides={torch.float32: dict(rtol=1e-4, atol=1e-4)})
+binary_opinfo("logaddexp2", tol_overrides={torch.float32: dict(rtol=1e-4, atol=1e-4)})
+binary_opinfo("eq", dtypes=ALL, supports_grad=False)
+binary_opinfo("ne", dtypes=ALL, supports_grad=False)
+binary_opinfo("ge", dtypes=FLOATS_INTS, supports_grad=False)
+binary_opinfo("gt", dtypes=FLOATS_INTS, supports_grad=False)
+binary_opinfo("le", dtypes=FLOATS_INTS, supports_grad=False)
+binary_opinfo("lt", dtypes=FLOATS_INTS, supports_grad=False)
+binary_opinfo("logical_and", dtypes=ALL, supports_grad=False)
+binary_opinfo("logical_or", dtypes=ALL, supports_grad=False)
+binary_opinfo("logical_xor", dtypes=ALL, supports_grad=False)
+binary_opinfo("bitwise_and", dtypes=INTS + BOOLS, supports_grad=False)
+binary_opinfo("bitwise_or", dtypes=INTS + BOOLS, supports_grad=False)
+binary_opinfo("bitwise_xor", dtypes=INTS + BOOLS, supports_grad=False)
+binary_opinfo("heaviside", supports_grad=False)
+
+
+def _xlogy_samples(dtype):
+    yield SampleInput(make_tensor((4, 5), dtype, seed=16),
+                      make_tensor((4, 5), dtype, low=0.2, high=3.0, seed=17))
+
+
+_add(OpInfo("xlogy", ltorch.xlogy, torch.xlogy, _xlogy_samples, dtypes=FLOATS32))
+
+
+def _isclose_samples(dtype):
+    a = make_tensor((4, 5), dtype, seed=18)
+    b = a.clone()
+    with torch.no_grad():
+        b.view(-1)[0] += 1.0
+    yield SampleInput(a, b)
+    yield SampleInput(a, a * (1 + 1e-7) if dtype.is_floating_point else a)
+
+
+_add(OpInfo("isclose", ltorch.isclose, torch.isclose, _isclose_samples,
+            dtypes=FLOATS32 + INTS, supports_grad=False))
+
+
+def _ternary_samples(dtype):
+    yield SampleInput(make_tensor((4, 5), dtype, seed=21),
+                      make_tensor((4, 5), dtype, seed=22),
+                      make_tensor((4, 5), dtype, low=0.5, high=2.0, seed=23))
+
+
+_add(OpInfo("addcmul", ltorch.addcmul, torch.addcmul, _ternary_samples))
+_add(OpInfo("addcdiv", ltorch.addcdiv, torch.addcdiv, _ternary_samples))
+_add(OpInfo("lerp", ltorch.lerp, torch.lerp, _ternary_samples))
+
+
+def _where_samples(dtype):
+    yield SampleInput(make_tensor((4, 5), torch.bool, seed=24),
+                      make_tensor((4, 5), dtype, seed=25),
+                      make_tensor((4, 5), dtype, seed=26))
+
+
+_add(OpInfo("where", ltorch.where, torch.where, _where_samples, dtypes=FLOATS_INTS))
+
+
+def _clamp_samples(dtype):
+    yield SampleInput(make_tensor((4, 5), dtype, seed=27), -0.5, 0.5)
+    yield SampleInput(make_tensor((4, 5), dtype, seed=28), None, 0.5)
+    yield SampleInput(make_tensor((4, 5), dtype, seed=29), -0.5, None)
+
+
+_add(OpInfo("clamp", ltorch.clamp, torch.clamp, _clamp_samples))
+
+
+def _masked_fill_samples(dtype):
+    yield SampleInput(make_tensor((4, 5), dtype, seed=30),
+                      make_tensor((4, 5), torch.bool, seed=31),
+                      -2.0 if dtype.is_floating_point else -2)
+
+
+_add(OpInfo("masked_fill", ltorch.masked_fill, torch.Tensor.masked_fill,
+            _masked_fill_samples, dtypes=FLOATS_INTS))
+
+
+# =============================================================================
+# Shape / indexing
+# =============================================================================
+
+
+def shape_opinfo(name, op, torch_ref, gen, *, dtypes=FLOATS32 + INTS, supports_grad=True, **kw):
+    return _add(OpInfo(name, op, torch_ref, gen, dtypes=dtypes, supports_grad=supports_grad, **kw))
+
+
+shape_opinfo("reshape", ltorch.reshape, torch.reshape,
+             lambda dt: iter([SampleInput(make_tensor((4, 6), dt, seed=40), (2, 12)),
+                              SampleInput(make_tensor((4, 6), dt, seed=41), (-1, 3)),
+                              SampleInput(make_tensor((2, 3, 4), dt, seed=42), (24,))]))
+shape_opinfo("permute", ltorch.permute, torch.permute,
+             lambda dt: iter([SampleInput(make_tensor((2, 3, 4), dt, seed=43), (2, 0, 1))]))
+shape_opinfo("transpose", ltorch.transpose, torch.transpose,
+             lambda dt: iter([SampleInput(make_tensor((2, 3, 4), dt, seed=44), 0, 2),
+                              SampleInput(make_tensor((2, 3), dt, seed=45), -1, -2)]))
+shape_opinfo("squeeze", ltorch.squeeze, torch.squeeze,
+             lambda dt: iter([SampleInput(make_tensor((2, 1, 3, 1), dt, seed=46)),
+                              SampleInput(make_tensor((2, 1, 3), dt, seed=47), 1)]))
+shape_opinfo("unsqueeze", ltorch.unsqueeze, torch.unsqueeze,
+             lambda dt: iter([SampleInput(make_tensor((2, 3), dt, seed=48), 1),
+                              SampleInput(make_tensor((2, 3), dt, seed=49), -1)]))
+shape_opinfo("flatten", ltorch.flatten, torch.flatten,
+             lambda dt: iter([SampleInput(make_tensor((2, 3, 4), dt, seed=50)),
+                              SampleInput(make_tensor((2, 3, 4), dt, seed=51), 1, 2)]))
+shape_opinfo("cat", ltorch.cat, torch.cat,
+             lambda dt: iter([SampleInput([make_tensor((2, 3), dt, seed=52), make_tensor((4, 3), dt, seed=53)], 0),
+                              SampleInput([make_tensor((2, 3), dt, seed=54), make_tensor((2, 5), dt, seed=55)], 1)]))
+shape_opinfo("stack", ltorch.stack, torch.stack,
+             lambda dt: iter([SampleInput([make_tensor((2, 3), dt, seed=56), make_tensor((2, 3), dt, seed=57)], 0)]))
+shape_opinfo("chunk", ltorch.chunk, torch.chunk,
+             lambda dt: iter([SampleInput(make_tensor((6, 4), dt, seed=58), 3, 0)]))
+shape_opinfo("split", ltorch.split, torch.split,
+             lambda dt: iter([SampleInput(make_tensor((6, 4), dt, seed=59), 2, 0),
+                              SampleInput(make_tensor((6, 4), dt, seed=60), [2, 4], 0)]))
+shape_opinfo("expand", ltorch.expand, torch.Tensor.expand,
+             lambda dt: iter([SampleInput(make_tensor((1, 3), dt, seed=61), (4, 3)),
+                              SampleInput(make_tensor((2, 1, 3), dt, seed=62), (2, 5, 3))]))
+shape_opinfo("repeat", ltorch.repeat, torch.Tensor.repeat,
+             lambda dt: iter([SampleInput(make_tensor((2, 3), dt, seed=63), (2, 2)),
+                              SampleInput(make_tensor((3,), dt, seed=64), (2, 4))]))
+shape_opinfo("flip", ltorch.flip, torch.flip,
+             lambda dt: iter([SampleInput(make_tensor((3, 4), dt, seed=65), (0,)),
+                              SampleInput(make_tensor((3, 4), dt, seed=66), (0, 1))]))
+shape_opinfo("roll", ltorch.roll, torch.roll,
+             lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=67), 2, 1),
+                              SampleInput(make_tensor((4, 5), dt, seed=68), (1, -2), (0, 1)),
+                              SampleInput(make_tensor((4, 5), dt, seed=69), 3)]))
+shape_opinfo("narrow", ltorch.narrow, torch.narrow,
+             lambda dt: iter([SampleInput(make_tensor((5, 6), dt, seed=70), 1, 2, 3)]))
+shape_opinfo("select", ltorch.select, torch.select,
+             lambda dt: iter([SampleInput(make_tensor((5, 6), dt, seed=71), 0, 2),
+                              SampleInput(make_tensor((5, 6), dt, seed=72), 1, -2)]))
+shape_opinfo("unbind", ltorch.unbind, torch.unbind,
+             lambda dt: iter([SampleInput(make_tensor((3, 4), dt, seed=73), 0)]))
+shape_opinfo("broadcast_to", ltorch.broadcast_to, torch.broadcast_to,
+             lambda dt: iter([SampleInput(make_tensor((1, 4), dt, seed=74), (3, 4))]))
+shape_opinfo("tile", ltorch.tile, torch.tile,
+             lambda dt: iter([SampleInput(make_tensor((2, 3), dt, seed=75), (2, 1, 2))]))
+shape_opinfo("swapaxes", ltorch.swapaxes, torch.swapaxes,
+             lambda dt: iter([SampleInput(make_tensor((2, 3, 4), dt, seed=76), 0, 2)]))
+shape_opinfo("ravel", ltorch.ravel, torch.ravel,
+             lambda dt: iter([SampleInput(make_tensor((2, 3, 4), dt, seed=77))]))
+shape_opinfo("unflatten", ltorch.unflatten, torch.unflatten,
+             lambda dt: iter([SampleInput(make_tensor((2, 12), dt, seed=78), 1, (3, 4)),
+                              SampleInput(make_tensor((2, 12), dt, seed=79), 1, (-1, 4))]))
+shape_opinfo("unfold", ltorch.unfold, torch.Tensor.unfold,
+             lambda dt: iter([SampleInput(make_tensor((4, 10), dt, seed=80), 1, 3, 2),
+                              SampleInput(make_tensor((8,), dt, seed=81), 0, 4, 4)]))
+shape_opinfo("movedim", ltorch.movedim, torch.movedim,
+             lambda dt: iter([SampleInput(make_tensor((2, 3, 4), dt, seed=82), 0, 2)]))
+shape_opinfo("tril", ltorch.tril, torch.tril,
+             lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=83)),
+                              SampleInput(make_tensor((4, 5), dt, seed=84), 1)]))
+shape_opinfo("triu", ltorch.triu, torch.triu,
+             lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=85), -1)]))
+shape_opinfo("diag", ltorch.diag, torch.diag,
+             lambda dt: iter([SampleInput(make_tensor((5,), dt, seed=86)),
+                              SampleInput(make_tensor((5,), dt, seed=87), 2),
+                              SampleInput(make_tensor((4, 6), dt, seed=88), -1)]))
+shape_opinfo("diagonal", ltorch.diagonal_sym, torch.diagonal,
+             lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=89)),
+                              SampleInput(make_tensor((2, 4, 5), dt, seed=90), 1, 1, 2)]))
+shape_opinfo("repeat_interleave", ltorch.repeat_interleave, torch.repeat_interleave,
+             lambda dt: iter([SampleInput(make_tensor((3, 4), dt, seed=91), 2, 1),
+                              SampleInput(make_tensor((3, 4), dt, seed=92), 3)]))
+shape_opinfo("hstack", ltorch.hstack, torch.hstack,
+             lambda dt: iter([SampleInput([make_tensor((2, 3), dt, seed=93), make_tensor((2, 2), dt, seed=94)]),
+                              SampleInput([make_tensor((3,), dt, seed=95), make_tensor((2,), dt, seed=96)])]))
+shape_opinfo("vstack", ltorch.vstack, torch.vstack,
+             lambda dt: iter([SampleInput([make_tensor((2, 3), dt, seed=97), make_tensor((1, 3), dt, seed=98)]),
+                              SampleInput([make_tensor((3,), dt, seed=99), make_tensor((3,), dt, seed=100)])]))
+
+
+def _index_select_samples(dt):
+    yield SampleInput(make_tensor((5, 4), dt, seed=101), 0, torch.tensor([0, 3, 3, 1]))
+    yield SampleInput(make_tensor((5, 4), dt, seed=102), 1, torch.tensor([2, 0]))
+
+
+shape_opinfo("index_select", ltorch.index_select, torch.index_select, _index_select_samples)
+
+
+def _gather_samples(dt):
+    idx = torch.tensor([[0, 2, 1], [3, 1, 0]])
+    yield SampleInput(make_tensor((4, 3), dt, seed=103), 0, idx)
+
+
+shape_opinfo("gather", ltorch.gather, torch.gather, _gather_samples)
+
+
+def _take_along_samples(dt):
+    idx = torch.tensor([[0, 2], [1, 3]])
+    yield SampleInput(make_tensor((2, 4), dt, seed=104), idx, 1)
+
+
+shape_opinfo("take_along_dim", ltorch.take_along_dim, torch.take_along_dim, _take_along_samples)
+
+
+def _scatter_add_samples(dt):
+    idx = torch.tensor([[0, 1, 2], [0, 1, 2]])
+    yield SampleInput(make_tensor((3, 3), dt, seed=105), 0, idx, make_tensor((2, 3), dt, seed=106))
+
+
+shape_opinfo("scatter_add", ltorch.scatter_add, torch.scatter_add, _scatter_add_samples)
+
+
+def _index_add_samples(dt):
+    yield SampleInput(make_tensor((5, 3), dt, seed=107), 0, torch.tensor([0, 4]),
+                      make_tensor((2, 3), dt, seed=108))
+
+
+shape_opinfo("index_add", ltorch.index_add, torch.index_add, _index_add_samples)
+
+
+def _index_copy_samples(dt):
+    yield SampleInput(make_tensor((5, 3), dt, seed=109), 0, torch.tensor([0, 4]),
+                      make_tensor((2, 3), dt, seed=110))
+
+
+shape_opinfo("index_copy", ltorch.index_copy, torch.index_copy, _index_copy_samples,
+             supports_grad=False)
+
+
+def _getitem_samples(dt):
+    yield SampleInput(make_tensor((4, 5), dt, seed=111), 2)
+    yield SampleInput(make_tensor((4, 5), dt, seed=112), (slice(1, 3), slice(None)))
+    yield SampleInput(make_tensor((4, 5, 6), dt, seed=113), (slice(None), 1))
+    yield SampleInput(make_tensor((4, 5), dt, seed=114), (Ellipsis, slice(0, 2)))
+
+
+shape_opinfo("getitem", ltorch.getitem, lambda a, k: a[k], _getitem_samples)
+
+
+def _topk_samples(dt):
+    yield SampleInput(make_tensor((4, 6), dt, seed=115), 3, 1)
+
+
+_add(OpInfo("topk", ltorch.topk, torch.topk, _topk_samples, dtypes=FLOATS32, supports_grad=False))
+_add(OpInfo("sort", ltorch.sort, torch.sort,
+            lambda dt: iter([SampleInput(make_tensor((4, 6), dt, seed=116), 1),
+                             SampleInput(make_tensor((4, 6), dt, seed=117), 0, True)]),
+            dtypes=FLOATS32 + INTS, supports_grad=False))
+_add(OpInfo("argsort", ltorch.argsort, torch.argsort,
+            lambda dt: iter([SampleInput(make_tensor((4, 6), dt, seed=118), 1)]),
+            dtypes=FLOATS32 + INTS, supports_grad=False))
+_add(OpInfo("cumsum", ltorch.cumsum, torch.cumsum,
+            lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=119), 1),
+                             SampleInput(make_tensor((4, 5), dt, seed=120), 0)]),
+            dtypes=FLOATS32 + INTS))
+_add(OpInfo("cumprod", ltorch.cumprod, torch.cumprod,
+            lambda dt: iter([SampleInput(make_tensor((4, 5), dt, low=0.5, high=1.5, seed=121), 1)]),
+            dtypes=FLOATS32))
+
+
+# =============================================================================
+# Reductions
+# =============================================================================
+
+
+def _reduction_samples(dt):
+    yield SampleInput(make_tensor((4, 5), dt, seed=130))
+    yield SampleInput(make_tensor((4, 5), dt, seed=131), 1)
+    yield SampleInput(make_tensor((4, 5), dt, seed=132), 0, True)
+    yield SampleInput(make_tensor((2, 3, 4), dt, seed=133), (0, 2))
+
+
+def reduction_opinfo(name, *, torch_ref=None, dtypes=FLOATS, supports_grad=True, gen=None, op=None):
+    return _add(OpInfo(name, op or getattr(ltorch, name), torch_ref or getattr(torch, name),
+                       gen or _reduction_samples, dtypes=dtypes, supports_grad=supports_grad))
+
+
+reduction_opinfo("sum", dtypes=FLOATS_INTS)
+reduction_opinfo("mean")
+reduction_opinfo("amax", dtypes=FLOATS_INTS)
+reduction_opinfo("amin", dtypes=FLOATS_INTS)
+reduction_opinfo("prod", gen=lambda dt: iter([SampleInput(make_tensor((4, 5), dt, low=0.5, high=1.5, seed=134)),
+                                              SampleInput(make_tensor((4, 5), dt, low=0.5, high=1.5, seed=135), 1)]))
+reduction_opinfo("argmax", dtypes=FLOATS32 + INTS, supports_grad=False,
+                 gen=lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=136)),
+                                      SampleInput(make_tensor((4, 5), dt, seed=137), 1),
+                                      SampleInput(make_tensor((4, 5), dt, seed=138), 0, True)]))
+reduction_opinfo("argmin", dtypes=FLOATS32 + INTS, supports_grad=False,
+                 gen=lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=139), 1)]))
+reduction_opinfo("max", gen=lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=140)),
+                                             SampleInput(make_tensor((4, 5), dt, seed=141), 1)]),
+                 supports_grad=False)
+reduction_opinfo("min", gen=lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=142), 0)]),
+                 supports_grad=False)
+reduction_opinfo("var", gen=lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=143)),
+                                             SampleInput(make_tensor((4, 5), dt, seed=144), 1)]))
+reduction_opinfo("std", gen=lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=145), 1)]))
+reduction_opinfo("var_mean", gen=lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=146), 1)]))
+reduction_opinfo("std_mean", gen=lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=147), 1)]))
+reduction_opinfo("all", dtypes=ALL, supports_grad=False,
+                 gen=lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=148)),
+                                      SampleInput(make_tensor((4, 5), dt, seed=149), 1)]))
+reduction_opinfo("any", dtypes=ALL, supports_grad=False,
+                 gen=lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=150), 0)]))
+reduction_opinfo("logsumexp",
+                 gen=lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=151), 1),
+                                      SampleInput(make_tensor((4, 5), dt, seed=152), (0, 1), True)]))
+reduction_opinfo("count_nonzero", dtypes=FLOATS32 + INTS + BOOLS, supports_grad=False,
+                 gen=lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=153)),
+                                      SampleInput(make_tensor((4, 5), dt, seed=154), 1)]))
+
+
+def _norm_samples(dt):
+    yield SampleInput(make_tensor((4, 5), dt, seed=155), 2, 1)
+    yield SampleInput(make_tensor((4, 5), dt, seed=156), 1, 0)
+    yield SampleInput(make_tensor((4, 5), dt, seed=157), float("inf"), 1)
+
+
+reduction_opinfo("norm", gen=_norm_samples, dtypes=FLOATS32)
+
+
+# =============================================================================
+# Matmul family
+# =============================================================================
+
+
+_add(OpInfo("matmul", ltorch.matmul, torch.matmul,
+            lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=160), make_tensor((5, 3), dt, seed=161)),
+                             SampleInput(make_tensor((2, 4, 5), dt, seed=162), make_tensor((2, 5, 3), dt, seed=163)),
+                             SampleInput(make_tensor((5,), dt, seed=164), make_tensor((5,), dt, seed=165)),
+                             SampleInput(make_tensor((2, 3, 4), dt, seed=166), make_tensor((4,), dt, seed=167))])))
+_add(OpInfo("bmm", ltorch.bmm, torch.bmm,
+            lambda dt: iter([SampleInput(make_tensor((2, 4, 5), dt, seed=168), make_tensor((2, 5, 3), dt, seed=169))])))
+_add(OpInfo("mm", ltorch.mm, torch.mm,
+            lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=170), make_tensor((5, 3), dt, seed=171))])))
+_add(OpInfo("mv", ltorch.mv, torch.mv,
+            lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=172), make_tensor((5,), dt, seed=173))])))
+_add(OpInfo("dot", ltorch.dot, torch.dot,
+            lambda dt: iter([SampleInput(make_tensor((5,), dt, seed=174), make_tensor((5,), dt, seed=175))])))
+_add(OpInfo("outer", ltorch.outer, torch.outer,
+            lambda dt: iter([SampleInput(make_tensor((4,), dt, seed=176), make_tensor((5,), dt, seed=177))])))
+_add(OpInfo("addmm", ltorch.addmm, torch.addmm,
+            lambda dt: iter([SampleInput(make_tensor((4, 3), dt, seed=178), make_tensor((4, 5), dt, seed=179),
+                                         make_tensor((5, 3), dt, seed=180)),
+                             SampleInput(make_tensor((4, 3), dt, seed=181), make_tensor((4, 5), dt, seed=182),
+                                         make_tensor((5, 3), dt, seed=183), beta=0.5, alpha=2.0)])))
+_add(OpInfo("baddbmm", ltorch.baddbmm, torch.baddbmm,
+            lambda dt: iter([SampleInput(make_tensor((2, 4, 3), dt, seed=184), make_tensor((2, 4, 5), dt, seed=185),
+                                         make_tensor((2, 5, 3), dt, seed=186), beta=0.5, alpha=2.0)])))
+_add(OpInfo("addbmm", ltorch.addbmm, torch.addbmm,
+            lambda dt: iter([SampleInput(make_tensor((4, 3), dt, seed=187), make_tensor((2, 4, 5), dt, seed=188),
+                                         make_tensor((2, 5, 3), dt, seed=189))])))
+_add(OpInfo("linear", ltorch.linear, F.linear,
+            lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=190), make_tensor((3, 5), dt, seed=191)),
+                             SampleInput(make_tensor((2, 4, 5), dt, seed=192), make_tensor((3, 5), dt, seed=193),
+                                         make_tensor((3,), dt, seed=194))])))
+_add(OpInfo("einsum", ltorch.einsum, torch.einsum,
+            lambda dt: iter([SampleInput("ij,jk->ik", make_tensor((4, 5), dt, seed=195), make_tensor((5, 3), dt, seed=196)),
+                             SampleInput("bij,bjk->bik", make_tensor((2, 3, 4), dt, seed=197), make_tensor((2, 4, 5), dt, seed=198)),
+                             SampleInput("ij->ji", make_tensor((4, 5), dt, seed=200)),
+                             SampleInput("bhqd,bhkd->bhqk", make_tensor((2, 2, 3, 4), dt, seed=201),
+                                         make_tensor((2, 2, 5, 4), dt, seed=202))])))
+
+
+# =============================================================================
+# NN ops
+# =============================================================================
+
+
+def nn_opinfo(name, op, torch_ref, gen, *, dtypes=FLOATS, supports_grad=True, **kw):
+    return _add(OpInfo(name, op, torch_ref, gen, dtypes=dtypes, supports_grad=supports_grad, **kw))
+
+
+nn_opinfo("relu", ltorch.relu, F.relu, lambda dt: _unary_samples(dt))
+nn_opinfo("relu6", ltorch.relu6, F.relu6, lambda dt: _unary_samples(dt))
+nn_opinfo("leaky_relu", ltorch.leaky_relu, F.leaky_relu,
+          lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=210)),
+                           SampleInput(make_tensor((4, 5), dt, seed=211), 0.2)]))
+nn_opinfo("elu", ltorch.elu, F.elu, lambda dt: _unary_samples(dt))
+nn_opinfo("selu", ltorch.selu, F.selu, lambda dt: _unary_samples(dt))
+nn_opinfo("celu", ltorch.celu, F.celu, lambda dt: _unary_samples(dt))
+nn_opinfo("gelu", ltorch.gelu, F.gelu,
+          lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=212)),
+                           SampleInput(make_tensor((4, 5), dt, seed=213), approximate="tanh")]))
+nn_opinfo("silu", ltorch.silu, F.silu, lambda dt: _unary_samples(dt))
+nn_opinfo("mish", ltorch.mish, F.mish, lambda dt: _unary_samples(dt))
+nn_opinfo("hardswish", ltorch.hardswish, F.hardswish, lambda dt: _unary_samples(dt))
+nn_opinfo("hardtanh", ltorch.hardtanh, F.hardtanh, lambda dt: _unary_samples(dt))
+nn_opinfo("hardsigmoid", ltorch.hardsigmoid, F.hardsigmoid, lambda dt: _unary_samples(dt))
+nn_opinfo("logsigmoid", ltorch.logsigmoid, F.logsigmoid, lambda dt: _unary_samples(dt))
+nn_opinfo("softplus", ltorch.softplus, F.softplus, lambda dt: _unary_samples(dt))
+nn_opinfo("softsign", ltorch.softsign, F.softsign, lambda dt: _unary_samples(dt))
+nn_opinfo("tanhshrink", ltorch.tanhshrink, F.tanhshrink, lambda dt: _unary_samples(dt))
+nn_opinfo("hardshrink", ltorch.hardshrink, F.hardshrink, lambda dt: _unary_samples(dt))
+nn_opinfo("softshrink", ltorch.softshrink, F.softshrink, lambda dt: _unary_samples(dt))
+nn_opinfo("threshold", ltorch.threshold, F.threshold,
+          lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=214), 0.1, -1.0)]))
+nn_opinfo("glu", ltorch.glu, F.glu,
+          lambda dt: iter([SampleInput(make_tensor((4, 6), dt, seed=215)),
+                           SampleInput(make_tensor((4, 6), dt, seed=216), 0)]))
+nn_opinfo("prelu", ltorch.prelu, F.prelu,
+          lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=217), make_tensor((1,), dt, seed=218)),
+                           SampleInput(make_tensor((2, 3, 4), dt, seed=219), make_tensor((3,), dt, seed=220))]))
+nn_opinfo("softmax", ltorch.softmax, lambda a, d: F.softmax(a, d),
+          lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=221), 1),
+                           SampleInput(make_tensor((4, 5), dt, seed=222), 0)]))
+nn_opinfo("log_softmax", ltorch.log_softmax, lambda a, d: F.log_softmax(a, d),
+          lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=223), 1)]))
+nn_opinfo("softmin", ltorch.softmin, lambda a, d: F.softmin(a, d),
+          lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=224), 1)]))
+nn_opinfo("normalize", ltorch.normalize, F.normalize,
+          lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=225))]), dtypes=FLOATS32)
+nn_opinfo("layer_norm", ltorch.layer_norm, F.layer_norm,
+          lambda dt: iter([SampleInput(make_tensor((4, 6), dt, seed=226), (6,),
+                                       make_tensor((6,), dt, seed=227), make_tensor((6,), dt, seed=228)),
+                           SampleInput(make_tensor((2, 3, 6), dt, seed=229), (6,))]))
+nn_opinfo("group_norm", ltorch.group_norm, F.group_norm,
+          lambda dt: iter([SampleInput(make_tensor((2, 6, 4), dt, seed=230), 3,
+                                       make_tensor((6,), dt, seed=231), make_tensor((6,), dt, seed=232))]))
+nn_opinfo("batch_norm_eval", lambda *a, **k: ltorch.batch_norm(*a, **k),
+          lambda *a, **k: F.batch_norm(*a, **k),
+          lambda dt: iter([SampleInput(make_tensor((4, 3, 5), dt, seed=233),
+                                       torch.zeros(3, dtype=dt), torch.ones(3, dtype=dt),
+                                       make_tensor((3,), dt, seed=234), make_tensor((3,), dt, seed=235),
+                                       False)]),
+          supports_grad=False)
+nn_opinfo("instance_norm", ltorch.instance_norm, F.instance_norm,
+          lambda dt: iter([SampleInput(make_tensor((2, 3, 8), dt, seed=236))]), dtypes=FLOATS32)
+nn_opinfo("embedding", ltorch.embedding, F.embedding,
+          lambda dt: iter([SampleInput(torch.tensor([[0, 2], [4, 1]]), make_tensor((5, 6), dt, seed=237))]))
+nn_opinfo("one_hot", ltorch.one_hot, F.one_hot,
+          lambda dt: iter([SampleInput(torch.tensor([0, 2, 1, 4]), 5),
+                           SampleInput(torch.tensor([[0, 1], [3, 2]]), 4)]),
+          dtypes=(torch.int64,), supports_grad=False)
+nn_opinfo("conv1d", ltorch.conv1d, F.conv1d,
+          lambda dt: iter([SampleInput(make_tensor((2, 3, 8), dt, seed=238), make_tensor((4, 3, 3), dt, seed=239)),
+                           SampleInput(make_tensor((2, 3, 8), dt, seed=240), make_tensor((4, 3, 3), dt, seed=241),
+                                       make_tensor((4,), dt, seed=242), 2, 1)]))
+nn_opinfo("conv2d", ltorch.conv2d, F.conv2d,
+          lambda dt: iter([SampleInput(make_tensor((2, 3, 6, 6), dt, seed=243), make_tensor((4, 3, 3, 3), dt, seed=244),
+                                       make_tensor((4,), dt, seed=245), 1, 1),
+                           SampleInput(make_tensor((2, 4, 6, 6), dt, seed=246), make_tensor((4, 2, 3, 3), dt, seed=247),
+                                       None, 1, 0, 1, 2)]))
+nn_opinfo("max_pool1d", ltorch.max_pool1d, F.max_pool1d,
+          lambda dt: iter([SampleInput(make_tensor((2, 3, 8), dt, seed=248), 2),
+                           SampleInput(make_tensor((2, 3, 9), dt, seed=249), 3, 2, 1)]), dtypes=FLOATS32)
+nn_opinfo("max_pool2d", ltorch.max_pool2d, F.max_pool2d,
+          lambda dt: iter([SampleInput(make_tensor((2, 3, 8, 8), dt, seed=250), 2),
+                           SampleInput(make_tensor((2, 3, 8, 8), dt, seed=251), 3, 2, 1)]), dtypes=FLOATS32)
+nn_opinfo("avg_pool1d", ltorch.avg_pool1d, F.avg_pool1d,
+          lambda dt: iter([SampleInput(make_tensor((2, 3, 8), dt, seed=252), 2)]), dtypes=FLOATS32)
+nn_opinfo("avg_pool2d", ltorch.avg_pool2d, F.avg_pool2d,
+          lambda dt: iter([SampleInput(make_tensor((2, 3, 8, 8), dt, seed=253), 2),
+                           SampleInput(make_tensor((2, 3, 8, 8), dt, seed=254), 2, 2, 1)]), dtypes=FLOATS32)
+nn_opinfo("adaptive_avg_pool2d", ltorch.adaptive_avg_pool2d, F.adaptive_avg_pool2d,
+          lambda dt: iter([SampleInput(make_tensor((2, 3, 8, 8), dt, seed=255), 2),
+                           SampleInput(make_tensor((2, 3, 8, 8), dt, seed=256), 1)]), dtypes=FLOATS32)
+nn_opinfo("pad_constant", ltorch.pad, F.pad,
+          lambda dt: iter([SampleInput(make_tensor((2, 3), dt, seed=257), (1, 2)),
+                           SampleInput(make_tensor((2, 3, 4), dt, seed=258), (1, 1, 2, 0), "constant", 1.5),
+                           SampleInput(make_tensor((2, 3), dt, seed=259), (-1, 1))]))
+nn_opinfo("pad_reflect", ltorch.pad,
+          lambda a, p, m: F.pad(a.unsqueeze(0), p, m).squeeze(0),
+          lambda dt: iter([SampleInput(make_tensor((3, 6), dt, seed=260), (2, 1), "reflect")]),
+          dtypes=FLOATS32)
+nn_opinfo("pad_replicate", ltorch.pad,
+          lambda a, p, m: F.pad(a.unsqueeze(0), p, m).squeeze(0),
+          lambda dt: iter([SampleInput(make_tensor((3, 6), dt, seed=261), (2, 3), "replicate")]),
+          dtypes=FLOATS32)
+nn_opinfo("interpolate_nearest", ltorch.interpolate,
+          lambda a, **k: F.interpolate(a, **k),
+          lambda dt: iter([SampleInput(make_tensor((1, 2, 4, 6), dt, seed=262), scale_factor=2.0),
+                           SampleInput(make_tensor((1, 2, 8), dt, seed=263), size=4)]),
+          dtypes=FLOATS32)
+nn_opinfo("interpolate_bilinear", ltorch.interpolate,
+          lambda a, **k: F.interpolate(a, **k),
+          lambda dt: iter([SampleInput(make_tensor((1, 2, 4, 6), dt, seed=264), size=(8, 3), mode="bilinear"),
+                           SampleInput(make_tensor((1, 2, 4, 6), dt, seed=265), size=(8, 3), mode="bilinear",
+                                       align_corners=True)]),
+          dtypes=FLOATS32)
+nn_opinfo("dropout_off", lambda a: ltorch.dropout(a, 0.0), lambda a: F.dropout(a, 0.0),
+          lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=266))]))
+nn_opinfo("scaled_dot_product_attention", ltorch.scaled_dot_product_attention,
+          F.scaled_dot_product_attention,
+          lambda dt: iter([SampleInput(make_tensor((2, 2, 8, 16), dt, seed=267),
+                                       make_tensor((2, 2, 8, 16), dt, seed=268),
+                                       make_tensor((2, 2, 8, 16), dt, seed=269), is_causal=True),
+                           SampleInput(make_tensor((2, 2, 8, 16), dt, seed=270),
+                                       make_tensor((2, 2, 8, 16), dt, seed=271),
+                                       make_tensor((2, 2, 8, 16), dt, seed=272))]),
+          tol_overrides={torch.float32: dict(rtol=1e-4, atol=1e-4)})
+
+
+# losses
+def _ce_samples(dt):
+    yield SampleInput(make_tensor((6, 5), dt, seed=280), torch.tensor([0, 4, 2, 1, 3, 0]))
+    yield SampleInput(make_tensor((6, 5), dt, seed=281), torch.tensor([0, 4, -100, 1, 3, 0]))
+    yield SampleInput(make_tensor((6, 5), dt, seed=282), torch.tensor([2, 0, 1, 1, 4, 3]),
+                      None, -100, "sum")
+
+
+nn_opinfo("cross_entropy", ltorch.cross_entropy, F.cross_entropy, _ce_samples,
+          tol_overrides={torch.float32: dict(rtol=1e-4, atol=1e-5)})
+nn_opinfo("nll_loss", ltorch.nll_loss, F.nll_loss,
+          lambda dt: iter([SampleInput(make_tensor((6, 5), dt, seed=283), torch.tensor([0, 4, 2, 1, 3, 0]))]))
+nn_opinfo("mse_loss", ltorch.mse_loss, F.mse_loss,
+          lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=284), make_tensor((4, 5), dt, seed=285)),
+                           SampleInput(make_tensor((4, 5), dt, seed=286), make_tensor((4, 5), dt, seed=287), "sum")]))
+nn_opinfo("l1_loss", ltorch.l1_loss, F.l1_loss,
+          lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=288), make_tensor((4, 5), dt, seed=289))]))
+nn_opinfo("smooth_l1_loss", ltorch.smooth_l1_loss, F.smooth_l1_loss,
+          lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=290), make_tensor((4, 5), dt, seed=291))]))
+nn_opinfo("huber_loss", ltorch.huber_loss, F.huber_loss,
+          lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=292), make_tensor((4, 5), dt, seed=293))]),
+          dtypes=FLOATS32)
+
+
+def _bce_samples(dt):
+    yield SampleInput(make_tensor((4, 5), dt, low=0.05, high=0.95, seed=294),
+                      (make_tensor((4, 5), torch.float32, seed=295) > 0).to(dt))
+
+
+nn_opinfo("binary_cross_entropy", ltorch.binary_cross_entropy, F.binary_cross_entropy,
+          _bce_samples, dtypes=FLOATS32)
+
+
+def _bcel_samples(dt):
+    yield SampleInput(make_tensor((4, 5), dt, seed=296),
+                      (make_tensor((4, 5), torch.float32, seed=297) > 0).to(dt))
+
+
+nn_opinfo("binary_cross_entropy_with_logits", ltorch.binary_cross_entropy_with_logits,
+          F.binary_cross_entropy_with_logits, _bcel_samples, dtypes=FLOATS32)
+
+
+def _kl_samples(dt):
+    a = F.log_softmax(make_tensor((4, 5), torch.float32, seed=298), 1).to(dt)
+    b = F.softmax(make_tensor((4, 5), torch.float32, seed=299), 1).to(dt)
+    yield SampleInput(a, b)
+    yield SampleInput(a, b, "batchmean")
+
+
+nn_opinfo("kl_div", ltorch.kl_div, F.kl_div, _kl_samples, dtypes=FLOATS32)
+
+
+# =============================================================================
+# Creation ops (compared by value where deterministic)
+# =============================================================================
+
+
+_add(OpInfo("zeros_like", ltorch.zeros_like, torch.zeros_like,
+            lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=300))]),
+            dtypes=FLOATS32 + INTS, supports_grad=False))
+_add(OpInfo("ones_like", ltorch.ones_like, torch.ones_like,
+            lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=301))]),
+            dtypes=FLOATS32 + INTS, supports_grad=False))
+_add(OpInfo("full_like", ltorch.full_like, torch.full_like,
+            lambda dt: iter([SampleInput(make_tensor((4, 5), dt, seed=302), 3)]),
+            dtypes=FLOATS32 + INTS, supports_grad=False))
+_add(OpInfo("eye", lambda n, m=None: ltorch.eye(n, m), lambda n, m=None: torch.eye(n) if m is None else torch.eye(n, m),
+            lambda dt: iter([SampleInput(4), SampleInput(3, 5)]), dtypes=FLOATS32, supports_grad=False))
+_add(OpInfo("linspace", ltorch.linspace, torch.linspace,
+            lambda dt: iter([SampleInput(0.0, 1.0, 7), SampleInput(-2.0, 2.0, 1)]),
+            dtypes=FLOATS32, supports_grad=False))
+_add(OpInfo("arange", ltorch.arange, torch.arange,
+            lambda dt: iter([SampleInput(5), SampleInput(1, 9, 2), SampleInput(0.0, 1.0, 0.25)]),
+            dtypes=FLOATS32, supports_grad=False))

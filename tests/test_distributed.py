@@ -155,6 +155,11 @@ class TestMultiDevice:
     def test_module_ddp_train(self):
         _run_scenario("module_ddp_train")
 
+    def test_batch_reduced_output(self):
+        """ADVICE r2: batch-dim-reducing outputs and non-batch aux inputs
+        must not be silently sharded/concatenated."""
+        _run_scenario("batch_reduced_output")
+
 
 class TestSequenceParallel:
     """Long-context parallelism — ring + Ulysses attention over the sp axis

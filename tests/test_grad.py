@@ -1,0 +1,135 @@
+"""Generated VJP-correctness matrix: OpInfo × dtype, vs torch autograd and
+(for smooth ops) central finite differences.
+
+Reference parity: thunder/tests/test_grad.py — per-OpInfo VJP checks against
+torch autograd plus finite-difference validation (the reference uses the fdm
+package; here a direct central-difference directional-derivative check in
+float64).
+"""
+
+import numpy as np
+import torch
+
+from framework import ops, tolerances
+from opinfos import opinfos
+
+import thunder_tpu
+import thunder_tpu.torch as ltorch
+from thunder_tpu.core.pytree import tree_flatten
+
+
+def _float_tensor_leaves(args, kwargs):
+    flat, _ = tree_flatten((args, kwargs))
+    return [x for x in flat if isinstance(x, torch.Tensor) and x.is_floating_point()]
+
+
+def _sum_outputs(out):
+    """Reduce an op's (possibly multi-tensor) output to a scalar loss."""
+    flat, _ = tree_flatten(out)
+    total = None
+    for o in flat:
+        if hasattr(o, "dtype") and hasattr(o, "shape"):
+            import thunder_tpu.core.dtypes as dt
+
+            s = ltorch.sum(o)
+            total = s if total is None else total + s
+    return total
+
+
+def _torch_sum_outputs(out):
+    flat, _ = tree_flatten(out)
+    total = None
+    for o in flat:
+        if isinstance(o, torch.Tensor) and o.is_floating_point():
+            s = o.sum()
+            total = s if total is None else total + s
+    return total
+
+
+GRAD_OPINFOS = [op for op in opinfos if op.supports_grad]
+
+# Smooth ops validated against float64 central differences as well.
+FD_OPS = {
+    "exp", "log", "tanh", "sigmoid", "sin", "cos", "erf", "expm1", "log1p",
+    "mul", "add", "sub", "div", "pow", "atan2", "hypot", "logaddexp",
+    "matmul", "mm", "bmm", "linear", "addmm", "einsum", "outer",
+    "softmax", "log_softmax", "layer_norm", "gelu", "silu", "softplus",
+    "mean", "sum", "var", "logsumexp", "mse_loss", "cross_entropy",
+}
+
+
+@ops(GRAD_OPINFOS, supported_dtypes=(torch.float32,))
+def test_grad(opinfo, executor, dtype):
+    for i, sample in enumerate(opinfo.grad_samples(dtype)):
+
+        def loss_fn(*args, **kwargs):
+            return _sum_outputs(opinfo.op(*args, **kwargs))
+
+        grads = executor.grad(loss_fn)(*sample.args, **sample.kwargs)
+        if not isinstance(grads, (tuple, list)):
+            grads = (grads,)
+
+        # torch-autograd oracle over the same float tensor leaves
+        flat, spec = tree_flatten((sample.args, sample.kwargs))
+        t_flat = [
+            x.detach().clone().requires_grad_(True)
+            if isinstance(x, torch.Tensor) and x.is_floating_point()
+            else x
+            for x in flat
+        ]
+        from thunder_tpu.core.pytree import tree_unflatten
+
+        targs, tkwargs = tree_unflatten(spec, t_flat)
+        loss = _torch_sum_outputs(opinfo.torch_ref(*targs, **tkwargs))
+        loss.backward()
+        want = [x.grad for x in t_flat if isinstance(x, torch.Tensor) and x.is_floating_point()]
+
+        assert len(grads) == len(want), (
+            f"{opinfo.name}: grad arity {len(grads)} != {len(want)}"
+        )
+        tol = tolerances(dtype, opinfo)
+        tol = dict(rtol=max(tol["rtol"], 1e-4), atol=max(tol["atol"], 1e-4))
+        for g, w in zip(grads, want):
+            if w is None:
+                continue
+            np.testing.assert_allclose(
+                np.asarray(g, dtype=np.float64),
+                w.detach().numpy().astype(np.float64),
+                err_msg=f"{opinfo.name} sample {i}",
+                **tol,
+            )
+
+        # Central finite differences in float64 (directional derivative):
+        # fd ≈ <grad, direction> for smooth ops.
+        if opinfo.name in FD_OPS and i == 0:
+            h = 1e-6
+            rng = np.random.RandomState(7)
+
+            def eval_ref(perturb):
+                flat2 = []
+                k = 0
+                for x in flat:
+                    if isinstance(x, torch.Tensor) and x.is_floating_point():
+                        flat2.append((x.double() + perturb[k]).to(torch.float64))
+                        k += 1
+                    else:
+                        flat2.append(x)
+                a2, kw2 = tree_unflatten(spec, flat2)
+                return float(_torch_sum_outputs(opinfo.torch_ref(*a2, **kw2)))
+
+            dirs = [
+                torch.from_numpy(rng.randn(*x.shape).astype(np.float64))
+                if x.ndim else torch.tensor(float(rng.randn()))
+                for x in (xx for xx in flat if isinstance(xx, torch.Tensor) and xx.is_floating_point())
+            ]
+            try:
+                fd = (eval_ref([h * d for d in dirs]) - eval_ref([-h * d for d in dirs])) / (2 * h)
+            except RuntimeError:
+                continue  # op lacks a float64 torch kernel
+            analytic = 0.0
+            for g, d in zip(grads, dirs):
+                analytic += float((np.asarray(g, dtype=np.float64) * d.numpy()).sum())
+            np.testing.assert_allclose(
+                analytic, fd, rtol=5e-3, atol=5e-4,
+                err_msg=f"{opinfo.name} finite-difference check",
+            )

@@ -1,0 +1,208 @@
+"""In-place op functionalization and buffer-mutating modules.
+
+Reference parity: thunder functionalizes in-place torch ops into SSA traces
+(thunder/torch/__init__.py registers `add_` and friends; SURVEY.md §7
+hard-part 2). Here the mechanism is proxy forwarding: the in-place wrapper
+computes the out-of-place result and points the stale proxy at it
+(thunder_tpu/torch/__init__.py `_mark_inplace`), and Symbol.__call__ resolves
+every later consumer.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+import thunder_tpu  # noqa: E402
+import thunder_tpu.torch as ttorch  # noqa: E402
+
+
+def _rand(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+class TestInplaceFunctionalization:
+    def test_basic_chain(self):
+        x, y = _rand(4, 8), _rand(4, 8, seed=1)
+
+        def f(a, b):
+            c = ttorch.mul(a, 1.0)
+            ttorch.add_(c, b)
+            ttorch.mul_(c, 2.0)
+            return c
+
+        got = thunder_tpu.jit(f)(x, y)
+        np.testing.assert_allclose(np.asarray(got), (x + y) * 2, rtol=1e-5, atol=1e-6)
+
+    def test_consumer_ordering(self):
+        """A read before the in-place update sees the old value; a read
+        after sees the new one."""
+        x = _rand(4, 8)
+
+        def f(a):
+            b = ttorch.mul(a, 2.0)
+            s1 = ttorch.sum(b)
+            ttorch.zero_(b)
+            s2 = ttorch.sum(b)
+            return s1, s2
+
+        s1, s2 = thunder_tpu.jit(f)(x)
+        assert abs(float(np.asarray(s1)) - 2 * x.sum()) < 1e-3
+        assert float(np.asarray(s2)) == 0.0
+
+    def test_inplace_keeps_dtype(self):
+        """torch in-place ops keep self's dtype: int.add_(int) stays int,
+        and the result of a promoting op is cast back."""
+        x = np.arange(8, dtype=np.int64)
+
+        def f(a):
+            b = ttorch.add(a, 0)
+            ttorch.add_(b, 1)
+            return b
+
+        got = thunder_tpu.jit(f)(x)
+        assert np.asarray(got).dtype == np.int64
+        np.testing.assert_array_equal(np.asarray(got), x + 1)
+
+    def test_masked_fill_and_clamp_(self):
+        x = _rand(4, 8)
+
+        def f(a):
+            b = ttorch.mul(a, 1.0)
+            ttorch.masked_fill_(b, ttorch.lt(b, 0.0), 0.5)
+            ttorch.clamp_(b, None, 1.0)
+            return b
+
+        got = thunder_tpu.jit(f)(x)
+        want = torch.from_numpy(x).clone()
+        want.masked_fill_(want < 0.0, 0.5).clamp_(max=1.0)
+        np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_copy_broadcast_and_cast(self):
+        x = _rand(4, 8)
+        row = _rand(8, seed=3)
+
+        def f(a, r):
+            b = ttorch.mul(a, 1.0)
+            ttorch.copy_(b, r)
+            return b
+
+        got = thunder_tpu.jit(f)(x, row)
+        np.testing.assert_allclose(np.asarray(got), np.broadcast_to(row, (4, 8)), rtol=1e-6)
+
+    def test_grads_flow_through_inplace(self):
+        """d/dx of sum((x*1).add_(y).mul_(2)) == 2 everywhere."""
+        x = _rand(4, 4)
+        y = _rand(4, 4, seed=5)
+
+        def f(a, b):
+            c = ttorch.mul(a, 1.0)
+            ttorch.add_(c, b)
+            ttorch.mul_(c, 2.0)
+            return ttorch.sum(c)
+
+        g = thunder_tpu.grad(f)(x, y)
+        gx = g[0] if isinstance(g, (tuple, list)) else g
+        np.testing.assert_allclose(np.asarray(gx), np.full((4, 4), 2.0), rtol=1e-6)
+
+    def test_alpha_kwarg(self):
+        """torch.add/sub alpha was previously silently ignored."""
+        x, y = _rand(4, 4), _rand(4, 4, seed=2)
+        got = thunder_tpu.jit(lambda a, b: ttorch.add(a, b, alpha=3.0))(x, y)
+        np.testing.assert_allclose(np.asarray(got), x + 3.0 * y, rtol=1e-5)
+        got = thunder_tpu.jit(lambda a, b: ttorch.sub(a, b, alpha=0.5))(x, y)
+        np.testing.assert_allclose(np.asarray(got), x - 0.5 * y, rtol=1e-5)
+
+
+class TestModuleInplace:
+    def test_module_with_inplace_forward(self):
+        """nn.Module whose forward mutates an intermediate in place."""
+
+        class M(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = torch.nn.Linear(8, 8)
+
+            def forward(self, x):
+                h = self.lin(x)
+                h.mul_(0.5)
+                h.add_(1.0)
+                return h.relu()
+
+        m = M()
+        tm = thunder_tpu.jit(m)
+        x = torch.from_numpy(_rand(4, 8))
+        np.testing.assert_allclose(
+            tm(x).detach().numpy(), m(x).detach().numpy(), rtol=1e-4, atol=1e-5
+        )
+
+    def test_batchnorm_eval_and_train_forward(self):
+        torch.manual_seed(0)
+        m = torch.nn.Sequential(torch.nn.Conv2d(3, 4, 3, padding=1), torch.nn.BatchNorm2d(4), torch.nn.ReLU())
+        x = torch.from_numpy(_rand(2, 3, 8, 8))
+
+        m.eval()
+        np.testing.assert_allclose(
+            thunder_tpu.jit(m)(x).detach().numpy(), m(x).detach().numpy(), rtol=1e-4, atol=1e-4
+        )
+
+        m.train()
+        got = thunder_tpu.jit(m)(x).detach().numpy()
+        want = m(x).detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    def test_batchnorm_running_stats_writeback(self):
+        """The epilogue replays recorded buffer mutation onto the module
+        (reference: jit_ext.py:1302 process_recorded_modifications)."""
+        torch.manual_seed(0)
+        m = torch.nn.BatchNorm2d(3)
+        m_ref = torch.nn.BatchNorm2d(3)
+        m_ref.load_state_dict(m.state_dict())
+        m.train(); m_ref.train()
+        x = torch.from_numpy(_rand(4, 3, 8, 8))
+        tm = thunder_tpu.jit(m)
+        for _ in range(3):
+            out = tm(x)
+            ref = m_ref(x)
+        np.testing.assert_allclose(out.detach().numpy(), ref.detach().numpy(), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(m.running_mean.numpy(), m_ref.running_mean.numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(m.running_var.numpy(), m_ref.running_var.numpy(), rtol=1e-4, atol=1e-5)
+        assert int(m.num_batches_tracked) == 3
+
+        m.eval(); m_ref.eval()
+        np.testing.assert_allclose(
+            thunder_tpu.jit(m)(x).detach().numpy(), m_ref(x).detach().numpy(), rtol=1e-3, atol=1e-4
+        )
+
+    def test_setattr_buffer_counter(self):
+        """A module assigning a new value to a registered buffer in forward
+        (self.steps = self.steps + 1) keeps counting across calls."""
+
+        class Counter(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.register_buffer("steps", torch.zeros(()))
+                self.lin = torch.nn.Linear(4, 4)
+
+            def forward(self, x):
+                self.steps = self.steps + 1.0
+                return self.lin(x) * 1.0
+
+        c = Counter()
+        tc = thunder_tpu.jit(c)
+        x = torch.from_numpy(_rand(2, 4))
+        for _ in range(5):
+            tc(x)
+        assert float(c.steps) == 5.0
+
+    def test_conv_grads(self):
+        torch.manual_seed(0)
+        m = torch.nn.Conv2d(3, 4, 3, padding=1, bias=True)
+        x = torch.from_numpy(_rand(2, 3, 8, 8))
+        thunder_tpu.jit(m)(x).sum().backward()
+        gw, gb = m.weight.grad.clone(), m.bias.grad.clone()
+        m.weight.grad = m.bias.grad = None
+        m(x).sum().backward()
+        np.testing.assert_allclose(gw.numpy(), m.weight.grad.numpy(), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(gb.numpy(), m.bias.grad.numpy(), rtol=1e-3, atol=1e-3)

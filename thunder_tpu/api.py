@@ -205,6 +205,12 @@ def trace_program(fn: Callable, args: tuple, kwargs: dict) -> tuple[TraceCtx, Tr
     with tracectx(comp_trc):
         with langctx_ctx(Languages.TORCH if _torch_lang_available() else Languages.CLANG):
             result = fn(*proxied_args, **proxied_kwargs)
+        if getattr(comp_trc, "_inplace_seen", False):
+            # A returned proxy may have been updated in place after it was
+            # produced — return its latest functional value.
+            from thunder_tpu.core.symbol import resolve_inplace_tree
+
+            result = resolve_inplace_tree(result)
         prims.python_return(result)
     comp_trc.output = result
 

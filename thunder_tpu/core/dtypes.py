@@ -231,6 +231,11 @@ def to_jax_dtype(d: dtype) -> Any:
     return np.dtype(getattr(jnp, _JNP_NAMES.get(d._name, d._name)))
 
 
+def finfo_max(d: dtype) -> float:
+    """Largest finite value of a float dtype (torch.finfo(d).max parity)."""
+    return float(np.finfo(to_jax_dtype(to_strong(d))).max)
+
+
 def from_jax_dtype(jd: Any) -> dtype:
     name = np.dtype(jd).name
     rev = {"bool": "bool8", "float8_e4m3fn": "float8_e4m3"}

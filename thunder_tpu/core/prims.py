@@ -114,6 +114,7 @@ class PrimIDs(enum.Enum):
     SORT = enum.auto()
     TOPK = enum.auto()
     CUMSUM = enum.auto()
+    CUMPROD = enum.auto()
     # Elementwise unary
     ABS = enum.auto()
     ACOS = enum.auto()
@@ -197,6 +198,7 @@ class PrimIDs(enum.Enum):
     MATMUL = enum.auto()
     LINEAR = enum.auto()
     CONVOLUTION = enum.auto()
+    CONVOLUTION_BWD = enum.auto()
     EMBEDDING = enum.auto()
     EMBEDDING_BACKWARD = enum.auto()
     POOL = enum.auto()
@@ -878,6 +880,15 @@ def _cumsum_meta(a: TensorProxy, dim: int) -> TensorProxy:
 cumsum = make_prim(PrimIDs.CUMSUM, "cumsum", _cumsum_meta)
 
 
+def _cumprod_meta(a: TensorProxy, dim: int) -> TensorProxy:
+    canonicalize_dim(a.ndim, dim)
+    out_dtype = dtypes.int64 if dtypes.is_exact_dtype(a.dtype) else a.dtype
+    return TensorProxy(like=a, dtype=out_dtype)
+
+
+cumprod = make_prim(PrimIDs.CUMPROD, "cumprod", _cumprod_meta)
+
+
 def _topk_meta(a: TensorProxy, k: int, dim: int, largest: bool, sorted: bool) -> tuple:
     dim = canonicalize_dim(a.ndim, dim)
     check(0 <= k <= a.shape[dim], lambda: f"topk k={k} out of range for dim of size {a.shape[dim]}")
@@ -1069,7 +1080,9 @@ def _polygamma_meta(n: int, a: TensorProxy) -> TensorProxy:
     return TensorProxy(like=a)
 
 
-polygamma = make_prim(PrimIDs.POLYGAMMA, "polygamma", _polygamma_meta, tags=(OpTags.ELEMENTWISE_UNARY_OP,))
+# No ELEMENTWISE_UNARY_OP tag: args[0] is an int order (not a tensor), and the
+# op is expensive — remat's cheap-to-recompute heuristic must not claim it.
+polygamma = make_prim(PrimIDs.POLYGAMMA, "polygamma", _polygamma_meta)
 
 
 def _where_meta(pred, a, b):
@@ -1219,6 +1232,26 @@ def _convolution_meta(
 
 
 convolution = make_prim(PrimIDs.CONVOLUTION, "convolution", _convolution_meta, tags=(OpTags.MATMUL_OP,))
+
+
+def _convolution_bwd_meta(
+    g: TensorProxy,
+    a: TensorProxy,
+    weight: TensorProxy,
+    stride: Sequence[int],
+    padding: Sequence[int],
+    dilation: Sequence[int],
+    groups: int,
+) -> tuple:
+    """(d_input, d_weight) of `convolution` — lowered by jaxex to the
+    transposed convolutions XLA compiles onto the MXU (reference seat: the
+    torch conv backward ATen kernels)."""
+    return TensorProxy(like=a), TensorProxy(like=weight)
+
+
+convolution_bwd = make_prim(
+    PrimIDs.CONVOLUTION_BWD, "convolution_bwd", _convolution_bwd_meta, tags=(OpTags.MATMUL_OP,)
+)
 
 
 def _embedding_meta(indices: TensorProxy, weight: TensorProxy) -> TensorProxy:

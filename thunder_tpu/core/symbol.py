@@ -31,6 +31,24 @@ def register_module(label: str, module: Any) -> None:
     MODULE_REGISTRY[label] = module
 
 
+def resolve_inplace(x: Any) -> Any:
+    """Follow a proxy's in-place forwarding chain to its latest functional
+    value. In-place torch ops (``x.add_(y)``) functionalize by computing the
+    out-of-place result and pointing the stale proxy at it; every later
+    consumer resolves through this (reference analogue: thunder's implicit
+    functionalization — generated traces are SSA)."""
+    nxt = getattr(x, "_inplace_forward", None)
+    while nxt is not None:
+        x = nxt
+        nxt = getattr(x, "_inplace_forward", None)
+    return x
+
+
+def resolve_inplace_tree(tree: Any) -> Any:
+    flat, spec = tree_flatten(tree)
+    return tree_unflatten(spec, [resolve_inplace(x) for x in flat])
+
+
 class Symbol:
     def __init__(
         self,
@@ -83,6 +101,11 @@ class Symbol:
             )
 
         check(self.meta is not None, lambda: f"Symbol {self.qualname} has no meta function")
+
+        # Cheap flag check: only traces that saw an in-place op pay for the
+        # per-call proxy remap (tracing latency is a product metric).
+        if getattr(trace, "_inplace_seen", False):
+            args, kwargs = resolve_inplace_tree((args, kwargs))
 
         if self.is_prim:
             result = self.meta(*args, **kwargs)

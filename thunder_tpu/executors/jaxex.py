@@ -169,6 +169,15 @@ def _cumsum(a, dim):
 _reg(PrimIDs.CUMSUM, _cumsum)
 
 
+def _cumprod(a, dim):
+    if jnp.issubdtype(a.dtype, jnp.bool_) or jnp.issubdtype(a.dtype, jnp.integer):
+        return jnp.cumprod(a, axis=dim, dtype=jnp.int64)
+    return jnp.cumprod(a, axis=dim)
+
+
+_reg(PrimIDs.CUMPROD, _cumprod)
+
+
 def _topk(a, k, dim, largest, sorted):
     a_m = jnp.moveaxis(a, dim, -1)
     if largest:
@@ -384,6 +393,14 @@ def _convolution(a, weight, bias, stride, padding, dilation, groups):
 
 
 _reg(PrimIDs.CONVOLUTION, _convolution)
+
+
+def _convolution_bwd(g, a, weight, stride, padding, dilation, groups):
+    _, vjp = jax.vjp(lambda x, w: _convolution(x, w, None, stride, padding, dilation, groups), a, weight)
+    return vjp(g)
+
+
+_reg(PrimIDs.CONVOLUTION_BWD, _convolution_bwd)
 _reg(PrimIDs.EMBEDDING, lambda idx, w: jnp.take(w, idx, axis=0))
 
 

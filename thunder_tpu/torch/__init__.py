@@ -82,6 +82,10 @@ def to_dtype(x) -> Optional[dtypes.dtype]:
     return dtypes.to_dtype(x) if x is not None else None
 
 
+# The module shadows several builtins with torch-mirror ops below.
+builtins_abs, builtins_min, builtins_max, builtins_sum = abs, min, max, sum
+
+
 def _dim_seq(dim) -> Optional[tuple]:
     if dim is None:
         return None
@@ -502,14 +506,123 @@ tan = _register_elementwise("tan", clang.tan, ["torch.tan"])
 tanh = _register_elementwise("tanh", clang.tanh, ["torch.tanh", "torch.Tensor.tanh"])
 trunc = _register_elementwise("trunc", clang.trunc, ["torch.trunc"])
 logical_not = _register_elementwise("logical_not", clang.logical_not, ["torch.logical_not"])
+acosh = _register_elementwise("acosh", clang.acosh, ["torch.acosh", "torch.arccosh"])
+asinh = _register_elementwise("asinh", clang.asinh, ["torch.asinh", "torch.arcsinh"])
+atanh = _register_elementwise("atanh", clang.atanh, ["torch.atanh", "torch.arctanh"])
+bitwise_not = _register_elementwise("bitwise_not", clang.bitwise_not, ["torch.bitwise_not"])
+digamma = _register_elementwise("digamma", clang.digamma, ["torch.digamma", "torch.special.digamma"])
+erfc = _register_elementwise("erfc", clang.erfc, ["torch.erfc", "torch.special.erfc"])
+erfinv = _register_elementwise("erfinv", clang.erfinv, ["torch.erfinv", "torch.special.erfinv"])
+exp2 = _register_elementwise("exp2", clang.exp2, ["torch.exp2", "torch.special.exp2"])
+lgamma = _register_elementwise("lgamma", clang.lgamma, ["torch.lgamma", "torch.special.gammaln"])
+log10 = _register_elementwise("log10", clang.log10, ["torch.log10"])
+signbit = _register_elementwise("signbit", clang.signbit, ["torch.signbit"])
+sgn = _register_elementwise("sgn", clang.sign, ["torch.sgn", "torch.Tensor.sgn"])
+
+
+@torchsymbol("torch.square", method_name="square")
+def square(a):
+    return clang.mul(a, a)
+
+
+@torchsymbol("torch.frac", method_name="frac")
+def frac(a):
+    return clang.sub(a, clang.trunc(a))
+
+
+@torchsymbol("torch.rad2deg")
+def rad2deg(a):
+    return clang.mul(a, 180.0 / math.pi)
+
+
+@torchsymbol("torch.deg2rad")
+def deg2rad(a):
+    return clang.mul(a, math.pi / 180.0)
+
+
+@torchsymbol("torch.logit", "torch.special.logit")
+def logit(a, eps: Optional[float] = None):
+    if eps is not None:
+        a = clang.clamp(a, eps, 1.0 - eps)
+    return clang.log(clang.true_divide(a, clang.sub(1.0, a)))
+
+
+@torchsymbol("torch.sinc", "torch.special.sinc")
+def sinc(a):
+    # sin(pi x)/(pi x), with the removable singularity patched at 0.
+    px = clang.mul(a, math.pi)
+    safe = clang.where(clang.eq(a, 0), clang.ones_like(px), px)
+    return clang.where(clang.eq(a, 0), clang.ones_like(px), clang.true_divide(clang.sin(safe), safe))
+
+
+@torchsymbol("torch.nan_to_num", method_name="nan_to_num")
+def nan_to_num(a, nan: float = 0.0, posinf: Optional[float] = None, neginf: Optional[float] = None):
+    check(isinstance(a, TensorProxy), "nan_to_num expects a tensor")
+    if not dtypes.is_float_dtype(a.dtype):
+        return prims.shallow_copy(a)
+    if posinf is None:
+        posinf = float(dtypes.finfo_max(a.dtype))
+    if neginf is None:
+        neginf = -float(dtypes.finfo_max(a.dtype))
+    r = clang.where(clang.isnan(a), clang.full_like(a, 0.0 if nan is None else nan), a)
+    r = clang.where(clang.eq(a, float("inf")), clang.full_like(a, posinf), r)
+    return clang.where(clang.eq(a, float("-inf")), clang.full_like(a, neginf), r)
+
+
+@torchsymbol("torch.polygamma", "torch.special.polygamma")
+def polygamma(n: int, a):
+    return clang.polygamma(int(pyval(n)), a)
 
 # binary
-add_sym = _register_elementwise("add", clang.add, ["torch.add", "torch.Tensor.add"])
+@torchsymbol("torch.add", "torch.Tensor.add", method_name="add")
+def add(a, b, *, alpha=None):
+    if alpha is not None and pyval(alpha) != 1:
+        b = clang.mul(b, alpha)
+    return clang.add(a, b)
+
+
+add_sym = add  # backwards-compatible alias
+
+
+@torchsymbol("torch.sub", "torch.subtract", "torch.Tensor.sub", method_name="sub")
+def sub(a, b, *, alpha=None):
+    if alpha is not None and pyval(alpha) != 1:
+        b = clang.mul(b, alpha)
+    return clang.sub(a, b)
+
+
+@torchsymbol("torch.rsub", "torch.Tensor.rsub", method_name="rsub")
+def rsub(a, b, *, alpha=None):
+    if alpha is not None and pyval(alpha) != 1:
+        a = clang.mul(a, alpha)
+    return clang.sub(b, a)
+
+
+@torchsymbol("torch.div", "torch.true_divide", "torch.Tensor.div", method_name="div")
+def div_sym(a, b, *, rounding_mode: Optional[str] = None):
+    if rounding_mode is None:
+        return clang.true_divide(a, b)
+    if rounding_mode == "floor":
+        return clang.floor_divide(a, b)
+    check(rounding_mode == "trunc", lambda: f"Unknown rounding_mode {rounding_mode}")
+    r = clang.true_divide(a, b)
+    if dtypes.is_float_dtype(r.dtype):
+        r = clang.trunc(r)
+    from_int = all(
+        not isinstance(x, TensorProxy) or dtypes.is_exact_dtype(x.dtype) for x in (a, b)
+    ) and not any(isinstance(x, float) for x in (a, b) if not isinstance(x, TensorProxy))
+    if from_int:
+        ref = a if isinstance(a, TensorProxy) else b
+        if isinstance(ref, TensorProxy) and dtypes.is_exact_dtype(ref.dtype):
+            r = clang.maybe_convert_to_dtype(r, ref.dtype)
+    return r
+
+
 atan2 = _register_elementwise("atan2", clang.atan2, ["torch.atan2"])
 bitwise_and = _register_elementwise("bitwise_and", clang.bitwise_and, ["torch.bitwise_and"])
 bitwise_or = _register_elementwise("bitwise_or", clang.bitwise_or, ["torch.bitwise_or"])
 bitwise_xor = _register_elementwise("bitwise_xor", clang.bitwise_xor, ["torch.bitwise_xor"])
-div = _register_elementwise("div", clang.true_divide, ["torch.div", "torch.true_divide", "torch.Tensor.div"])
+div = div_sym
 eq = _register_elementwise("eq", clang.eq, ["torch.eq"])
 floor_divide = _register_elementwise("floor_divide", clang.floor_divide, ["torch.floor_divide"])
 fmod = _register_elementwise("fmod", clang.fmod, ["torch.fmod"])
@@ -523,8 +636,10 @@ mul = _register_elementwise("mul", clang.mul, ["torch.mul", "torch.Tensor.mul"])
 ne = _register_elementwise("ne", clang.ne, ["torch.ne"])
 pow = _register_elementwise("pow", clang.pow, ["torch.pow", "torch.Tensor.pow"])
 remainder = _register_elementwise("remainder", clang.remainder, ["torch.remainder"])
-sub = _register_elementwise("sub", clang.sub, ["torch.sub", "torch.Tensor.sub"])
+copysign = _register_elementwise("copysign", clang.copysign, ["torch.copysign"])
 clamp = _register_elementwise("clamp", clang.clamp, ["torch.clamp", "torch.Tensor.clamp"])
+clamp_min = _register_elementwise("clamp_min", lambda a, m: clang.clamp(a, m, None), ["torch.clamp_min", "torch.Tensor.clamp_min"], method="clamp_min")
+clamp_max = _register_elementwise("clamp_max", lambda a, m: clang.clamp(a, None, m), ["torch.clamp_max", "torch.Tensor.clamp_max"], method="clamp_max")
 
 
 @torchsymbol("torch.sigmoid", "torch.nn.functional.sigmoid", method_name="sigmoid")
@@ -1116,6 +1231,1063 @@ def _register_composite_vjps():
 
 
 _register_composite_vjps()
+
+
+# =============================================================================
+# Additional binary / ternary ops
+# =============================================================================
+
+
+@torchsymbol("torch.logaddexp")
+def logaddexp(a, b):
+    m = clang.maximum(a, b)
+    d = clang.neg(clang.abs(clang.sub(a, b)))
+    r = clang.add(m, clang.log1p(clang.exp(d)))
+    # When both are -inf the max is -inf and the sum is -inf, not nan.
+    return clang.where(clang.isinf(m), m, r)
+
+
+@torchsymbol("torch.logaddexp2")
+def logaddexp2(a, b):
+    ln2 = math.log(2.0)
+    return clang.mul(logaddexp(clang.mul(a, ln2), clang.mul(b, ln2)), 1.0 / ln2)
+
+
+@torchsymbol("torch.hypot")
+def hypot(a, b):
+    return clang.sqrt(clang.add(clang.mul(a, a), clang.mul(b, b)))
+
+
+@torchsymbol("torch.logical_and", method_name="logical_and")
+def logical_and(a, b):
+    return clang.logical_and(a, b)
+
+
+@torchsymbol("torch.logical_or", method_name="logical_or")
+def logical_or(a, b):
+    return clang.logical_or(a, b)
+
+
+@torchsymbol("torch.logical_xor", method_name="logical_xor")
+def logical_xor(a, b):
+    ba = clang.ne(a, 0) if not dtypes.is_boolean_dtype(a.dtype) else a
+    bb = clang.ne(b, 0) if not dtypes.is_boolean_dtype(b.dtype) else b
+    return clang.ne(ba, bb)
+
+
+@torchsymbol("torch.xlogy", "torch.special.xlogy")
+def xlogy(a, b):
+    safe = clang.where(clang.eq(a, 0), clang.ones_like(b), b)
+    return clang.where(clang.eq(a, 0), clang.zeros_like(clang.mul(a, b)), clang.mul(a, clang.log(safe)))
+
+
+@torchsymbol("torch.addcmul", method_name="addcmul")
+def addcmul(a, t1, t2, *, value=1):
+    prod_ = clang.mul(t1, t2)
+    if pyval(value) != 1:
+        prod_ = clang.mul(prod_, value)
+    return clang.add(a, prod_)
+
+
+@torchsymbol("torch.addcdiv", method_name="addcdiv")
+def addcdiv(a, t1, t2, *, value=1):
+    q = clang.true_divide(t1, t2)
+    if pyval(value) != 1:
+        q = clang.mul(q, value)
+    return clang.add(a, q)
+
+
+@torchsymbol("torch.lerp", method_name="lerp")
+def lerp(start, end, weight):
+    return clang.add(start, clang.mul(clang.sub(end, start), weight))
+
+
+@torchsymbol("torch.isclose", method_name="isclose")
+def isclose(a, b, rtol: float = 1e-5, atol: float = 1e-8, equal_nan: bool = False):
+    close = clang.le(clang.abs(clang.sub(a, b)), clang.add(atol, clang.mul(rtol, clang.abs(b))))
+    if equal_nan:
+        close = clang.logical_or(close, clang.logical_and(clang.isnan(a), clang.isnan(b)))
+    return close
+
+
+@torchsymbol("torch.heaviside")
+def heaviside(a, values):
+    zero = clang.zeros_like(a)
+    one = clang.ones_like(a)
+    return clang.where(clang.gt(a, 0), one, clang.where(clang.lt(a, 0), zero, values))
+
+
+# =============================================================================
+# Additional shape / indexing ops
+# =============================================================================
+
+
+@torchsymbol("torch.narrow", method_name="narrow")
+def narrow(a, dim: int, start: int, length: int):
+    d = canonicalize_dim(a.ndim, int(pyval(dim)))
+    s = int(pyval(start))
+    if s < 0:
+        s += a.shape[d]
+    return clang.slice_in_dim(a, s, s + int(pyval(length)), dim=d)
+
+
+@torchsymbol("torch.select", method_name="select")
+def select(a, dim: int, index: int):
+    d = canonicalize_dim(a.ndim, int(pyval(dim)))
+    i = int(pyval(index))
+    if i < 0:
+        i += a.shape[d]
+    return clang.squeeze(clang.slice_in_dim(a, i, i + 1, dim=d), (d,))
+
+
+@torchsymbol("torch.unbind", method_name="unbind")
+def unbind(a, dim: int = 0):
+    d = canonicalize_dim(a.ndim, int(pyval(dim)))
+    return tuple(select(a, d, i) for i in range(a.shape[d]))
+
+
+@torchsymbol("torch.roll", method_name="roll")
+def roll(a, shifts, dims=None):
+    shifts = (int(pyval(shifts)),) if isinstance(shifts, (int, NumberProxy)) else tuple(int(pyval(s)) for s in shifts)
+    if dims is None:
+        check(len(shifts) == 1, "roll without dims takes a single shift")
+        flat = flatten(a)
+        return reshape(roll(flat, shifts, (0,)), tuple(a.shape))
+    dims = (int(pyval(dims)),) if isinstance(dims, (int, NumberProxy)) else tuple(int(pyval(d)) for d in dims)
+    check(len(shifts) == len(dims), "roll shifts/dims length mismatch")
+    r = a
+    for s, d in zip(shifts, dims):
+        d = canonicalize_dim(r.ndim, d)
+        n = r.shape[d]
+        if n == 0:
+            continue
+        s = s % n
+        if s == 0:
+            continue
+        head = clang.slice_in_dim(r, n - s, n, dim=d)
+        tail = clang.slice_in_dim(r, 0, n - s, dim=d)
+        r = clang.cat([head, tail], d)
+    return r
+
+
+@torchsymbol("torch.broadcast_to", method_name="broadcast_to")
+def broadcast_to(a, shape):
+    return clang.expand(a, tuple(int(pyval(s)) for s in shape))
+
+
+@torchsymbol("torch.tile", method_name="tile")
+def tile(a, *reps):
+    reps = reps[0] if len(reps) == 1 and isinstance(reps[0], (tuple, list)) else reps
+    reps = tuple(int(pyval(r)) for r in reps)
+    if len(reps) < a.ndim:
+        reps = (1,) * (a.ndim - len(reps)) + reps
+    return repeat(a, *reps)
+
+
+@torchsymbol("torch.swapaxes", "torch.swapdims", method_name="swapaxes")
+def swapaxes(a, dim0: int, dim1: int):
+    return clang.transpose(a, int(pyval(dim0)), int(pyval(dim1)))
+
+
+@torchsymbol("torch.ravel", method_name="ravel")
+def ravel(a):
+    return flatten(a)
+
+
+@torchsymbol("torch.unflatten", method_name="unflatten")
+def unflatten(a, dim: int, sizes):
+    d = canonicalize_dim(a.ndim, int(pyval(dim)))
+    sizes = [int(pyval(s)) for s in sizes]
+    if -1 in sizes:
+        idx = sizes.index(-1)
+        known = 1
+        for i, s in enumerate(sizes):
+            if i != idx:
+                known *= s
+        sizes[idx] = a.shape[d] // known
+    return clang.reshape(a, tuple(a.shape[:d]) + tuple(sizes) + tuple(a.shape[d + 1 :]))
+
+
+@torchsymbol("torch.Tensor.unfold", method_name="unfold")
+def unfold(a, dimension: int, size: int, step: int):
+    """Sliding windows along ``dimension``: dim is replaced by the window
+    count and a trailing dim of ``size`` is appended (torch.Tensor.unfold)."""
+    d = canonicalize_dim(a.ndim, int(pyval(dimension)))
+    size, step = int(pyval(size)), int(pyval(step))
+    L = a.shape[d]
+    check(size <= L, lambda: f"unfold size {size} > dim size {L}")
+    n = (L - size) // step + 1
+    starts = clang.mul(clang.arange(0, n, 1, device=a.device, dtype=dtypes.int64), step)
+    offs = clang.arange(0, size, 1, device=a.device, dtype=dtypes.int64)
+    idx = clang.add(clang.unsqueeze(starts, 1), clang.unsqueeze(offs, 0))  # (n, size)
+    moved = clang.movedim(a, d, -1)
+    flat_idx = clang.reshape(idx, (n * size,))
+    taken = prims.take(moved, flat_idx, moved.ndim - 1)
+    win = clang.reshape(taken, tuple(moved.shape[:-1]) + (n, size))
+    return clang.movedim(win, -2, d)
+
+
+@torchsymbol("torch.diag")
+def diag(a, diagonal: int = 0):
+    k = int(pyval(diagonal))
+    if a.ndim == 1:
+        n = a.shape[0] + builtins_abs(k)
+        rows = clang.arange(0, n, 1, device=a.device, dtype=dtypes.int64)
+        cols = clang.arange(0, n, 1, device=a.device, dtype=dtypes.int64)
+        eye_mask = clang.eq(clang.sub(clang.unsqueeze(cols, 0), clang.unsqueeze(rows, 1)), k)
+        padded = a
+        if k > 0:
+            padded = prims.pad(a, 0, ((k, 0, 0),))
+        elif k < 0:
+            padded = prims.pad(a, 0, ((0, -k, 0),))
+        return clang.where(eye_mask, clang.expand_to(clang.unsqueeze(padded, 0), (n, n)), 0)
+    check(a.ndim == 2, "diag expects a 1D or 2D tensor")
+    return diagonal_sym(a, k, 0, 1)
+
+
+@torchsymbol("torch.diagonal", method_name="diagonal", id="torch.diagonal")
+def diagonal_sym(a, offset: int = 0, dim1: int = 0, dim2: int = 1):
+    d1 = canonicalize_dim(a.ndim, int(pyval(dim1)))
+    d2 = canonicalize_dim(a.ndim, int(pyval(dim2)))
+    check(d1 != d2, "diagonal dims must differ")
+    k = int(pyval(offset))
+    n, m = a.shape[d1], a.shape[d2]
+    length = builtins_max(0, builtins_min(n, m - k) if k >= 0 else builtins_min(n + k, m))
+    # Move (d1, d2) to the end, then gather the diagonal along the last dim.
+    x = clang.movedim(a, (d1, d2), (a.ndim - 2, a.ndim - 1))
+    rows = clang.arange(0, length, 1, device=a.device, dtype=dtypes.int64)
+    if k >= 0:
+        ridx, cidx = rows, clang.add(rows, k)
+    else:
+        ridx, cidx = clang.add(rows, -k), rows
+    x = prims.take(x, ridx, x.ndim - 2)
+    # x: (..., length, m); pick per-row column cidx.
+    cidx_full = clang.expand_to(
+        clang.reshape(cidx, (1,) * (x.ndim - 2) + (length, 1)), tuple(x.shape[:-1]) + (1,)
+    )
+    return clang.squeeze(clang.take_along_axis(x, cidx_full, x.ndim - 1), (x.ndim - 1,))
+
+
+@torchsymbol("torch.index_add", method_name="index_add")
+def index_add(a, dim: int, index, source, *, alpha=1):
+    d = canonicalize_dim(a.ndim, int(pyval(dim)))
+    if pyval(alpha) != 1:
+        source = clang.mul(source, alpha)
+    idx = clang.expand_to(
+        clang.reshape(index, (1,) * d + (index.shape[0],) + (1,) * (a.ndim - d - 1)),
+        tuple(source.shape),
+    )
+    return clang.scatter_add(a, d, idx, source)
+
+
+@torchsymbol("torch.index_copy", method_name="index_copy")
+def index_copy(a, dim: int, index, source):
+    d = canonicalize_dim(a.ndim, int(pyval(dim)))
+    idx = clang.expand_to(
+        clang.reshape(index, (1,) * d + (index.shape[0],) + (1,) * (a.ndim - d - 1)),
+        tuple(source.shape),
+    )
+    # scatter-set = scatter_add of (src - current values at idx)
+    current = clang.gather(a, d, idx)
+    return clang.scatter_add(a, d, idx, clang.sub(source, current))
+
+
+@torchsymbol("torch.hstack")
+def hstack(tensors):
+    tensors = list(tensors)
+    return cat(tensors, 0 if tensors[0].ndim == 1 else 1)
+
+
+@torchsymbol("torch.vstack", "torch.row_stack")
+def vstack(tensors):
+    tensors = [reshape(t, (1,) + tuple(t.shape)) if t.ndim == 1 else t for t in tensors]
+    return cat(tensors, 0)
+
+
+# =============================================================================
+# Additional reductions
+# =============================================================================
+
+
+@torchsymbol("torch.logsumexp", method_name="logsumexp")
+def logsumexp(a, dim, keepdim: bool = False):
+    dims = _dim_seq(dim)
+    m = clang.amax(a, dims, True)
+    m = clang.where(clang.isfinite(m), m, clang.zeros_like(m))
+    r = clang.add(clang.log(clang.sum(clang.exp(clang.sub(a, m)), dims, True)), m)
+    if not keepdim:
+        canon = tuple(canonicalize_dim(a.ndim, d) for d in dims)
+        r = clang.squeeze(r, canon)
+    return r
+
+
+@torchsymbol("torch.cumprod", method_name="cumprod")
+def cumprod(a, dim: int, *, dtype=None):
+    r = prims.cumprod(a, canonicalize_dim(a.ndim, int(pyval(dim))))
+    if dtype is not None:
+        r = clang.maybe_convert_to_dtype(r, to_dtype(dtype))
+    return r
+
+
+@torchsymbol("torch.count_nonzero", method_name="count_nonzero")
+def count_nonzero(a, dim=None):
+    return clang.sum(clang.maybe_convert_to_dtype(clang.ne(a, 0), dtypes.int64), _dim_seq(dim))
+
+
+@torchsymbol("torch.norm", "torch.linalg.vector_norm", method_name="norm")
+def norm(a, p=2, dim=None, keepdim: bool = False, *, dtype=None):
+    if dtype is not None:
+        a = clang.maybe_convert_to_dtype(a, to_dtype(dtype))
+    dims = _dim_seq(dim)
+    if isinstance(p, str):
+        check(p == "fro", lambda: f"Unsupported norm order {p}")
+        p = 2
+    p = pyval(p)
+    if p == float("inf"):
+        return clang.amax(clang.abs(a), dims, keepdim)
+    if p == float("-inf"):
+        return clang.amin(clang.abs(a), dims, keepdim)
+    if p == 0:
+        return clang.sum(clang.maybe_convert_to_dtype(clang.ne(a, 0), a.dtype), dims, keepdim)
+    if p == 1:
+        return clang.sum(clang.abs(a), dims, keepdim)
+    if p == 2:
+        return clang.sqrt(clang.sum(clang.mul(a, a), dims, keepdim))
+    return clang.pow(clang.sum(clang.pow(clang.abs(a), p), dims, keepdim), 1.0 / p)
+
+
+@torchsymbol("torch.std_mean")
+def std_mean(a, dim=None, *, correction: Number = 1, keepdim: bool = False):
+    v, m = clang.var_mean(a, _dim_seq(dim), correction=correction, keepdim=keepdim)
+    return clang.sqrt(v), m
+
+
+# =============================================================================
+# Additional matmul family
+# =============================================================================
+
+
+@torchsymbol("torch.mm", method_name="mm")
+def mm(a, b):
+    check(a.ndim == 2 and b.ndim == 2, "mm requires rank-2 tensors")
+    return clang.matmul(a, b)
+
+
+@torchsymbol("torch.mv", method_name="mv")
+def mv(a, b):
+    check(a.ndim == 2 and b.ndim == 1, "mv requires a matrix and a vector")
+    return clang.matmul(a, b)
+
+
+@torchsymbol("torch.dot", method_name="dot")
+def dot(a, b):
+    check(a.ndim == 1 and b.ndim == 1, "dot requires rank-1 tensors")
+    return clang.matmul(a, b)
+
+
+@torchsymbol("torch.vdot", method_name="vdot")
+def vdot(a, b):
+    check(a.ndim == 1 and b.ndim == 1, "vdot requires rank-1 tensors")
+    return clang.matmul(a, b)  # real dtypes only; conj is identity
+
+
+@torchsymbol("torch.addmm", method_name="addmm")
+def addmm(a, m1, m2, *, beta=1, alpha=1):
+    r = clang.matmul(m1, m2)
+    if pyval(alpha) != 1:
+        r = clang.mul(r, alpha)
+    if pyval(beta) == 0:
+        return r
+    return clang.add(r, a if pyval(beta) == 1 else clang.mul(a, beta))
+
+
+@torchsymbol("torch.baddbmm", method_name="baddbmm")
+def baddbmm(a, b1, b2, *, beta=1, alpha=1):
+    check(b1.ndim == 3 and b2.ndim == 3, "baddbmm requires rank-3 batches")
+    r = clang.matmul(b1, b2)
+    if pyval(alpha) != 1:
+        r = clang.mul(r, alpha)
+    if pyval(beta) == 0:
+        return r
+    return clang.add(r, a if pyval(beta) == 1 else clang.mul(a, beta))
+
+
+@torchsymbol("torch.addbmm", method_name="addbmm")
+def addbmm(a, b1, b2, *, beta=1, alpha=1):
+    r = clang.sum(clang.matmul(b1, b2), (0,))
+    if pyval(alpha) != 1:
+        r = clang.mul(r, alpha)
+    if pyval(beta) == 0:
+        return r
+    return clang.add(r, a if pyval(beta) == 1 else clang.mul(a, beta))
+
+
+# =============================================================================
+# Additional creation ops
+# =============================================================================
+
+
+@torchsymbol("torch.empty_like")
+def empty_like(a, *, dtype=None, device=None, requires_grad: bool = False):
+    return clang.zeros_like(a, device=device, dtype=to_dtype(dtype))
+
+
+@torchsymbol("torch.rand_like")
+def rand_like(a, *, dtype=None, device=None, requires_grad: bool = False):
+    dt = to_dtype(dtype) or a.dtype
+    return clang.uniform(tuple(a.shape), 0.0, 1.0, device=device or a.device, dtype=dt)
+
+
+@torchsymbol("torch.randn_like")
+def randn_like(a, *, dtype=None, device=None, requires_grad: bool = False):
+    dt = to_dtype(dtype) or a.dtype
+    return clang.randn(tuple(a.shape), device=device or a.device, dtype=dt)
+
+
+@torchsymbol("torch.randint")
+def randint(low, high=None, size=None, *, dtype=None, device=None, requires_grad: bool = False, generator=None):
+    if high is None:  # randint(high, size)
+        low, high = 0, low
+    check(size is not None, "randint requires a size")
+    lo, hi = int(pyval(low)), int(pyval(high))
+    u = clang.uniform(tuple(size), float(lo), float(hi), device=device, dtype=dtypes.float32)
+    return clang.maybe_convert_to_dtype(clang.floor(u), to_dtype(dtype) or dtypes.int64)
+
+
+@torchsymbol("torch.bernoulli")
+def bernoulli(a, *, generator=None):
+    u = clang.uniform(tuple(a.shape), 0.0, 1.0, device=a.device, dtype=a.dtype)
+    return clang.maybe_convert_to_dtype(clang.lt(u, a), a.dtype)
+
+
+@torchsymbol("torch.eye")
+def eye(n: int, m: Optional[int] = None, *, dtype=None, device=None, requires_grad: bool = False):
+    n = int(pyval(n))
+    m = n if m is None else int(pyval(m))
+    rows = clang.arange(0, n, 1, device=device, dtype=dtypes.int64)
+    cols = clang.arange(0, m, 1, device=device, dtype=dtypes.int64)
+    mask = clang.eq(clang.unsqueeze(rows, 1), clang.unsqueeze(cols, 0))
+    return clang.maybe_convert_to_dtype(mask, to_dtype(dtype) or dtypes.float32)
+
+
+@torchsymbol("torch.linspace")
+def linspace(start, end, steps: int, *, dtype=None, device=None, requires_grad: bool = False):
+    steps = int(pyval(steps))
+    dt = to_dtype(dtype) or dtypes.float32
+    if steps == 1:
+        return clang.full((1,), start, device=device, dtype=dt)
+    i = clang.arange(0, steps, 1, device=device, dtype=dtypes.float32)
+    v = clang.add(clang.mul(i, (pyval(end) - pyval(start)) / (steps - 1)), pyval(start))
+    return clang.maybe_convert_to_dtype(v, dt)
+
+
+# =============================================================================
+# Pooling (XLA reduce_window via the pool prim; the prim seat matches the
+# reference's torch max/avg_poolNd ATen calls, thunder/torch/__init__.py)
+# =============================================================================
+
+
+def _pool_nd(a, kind: str, kernel, stride, padding, spatial: int, ceil_mode: bool, dilation=1):
+    def _seq(x):
+        return (int(pyval(x)),) * spatial if isinstance(x, (int, NumberProxy)) else tuple(int(pyval(v)) for v in x)
+
+    check(not ceil_mode, "pool ceil_mode is not supported yet")
+    d = _seq(dilation)
+    check(builtins_max(d) == 1, "pool dilation is not supported yet")
+    k = _seq(kernel)
+    s = _seq(stride) if stride is not None else k
+    p = _seq(padding)
+    for pi, ki in zip(p, k):
+        check(pi <= ki // 2, "pool padding must be <= half the kernel size")
+    check(a.ndim in (spatial + 1, spatial + 2), lambda: f"pool expects rank {spatial + 1} or {spatial + 2}")
+    pad_cfg = tuple((pi, pi) for pi in p)
+    return prims.pool(a, kind, k, s, pad_cfg)
+
+
+@torchsymbol("torch.nn.functional.max_pool1d")
+def max_pool1d(a, kernel_size, stride=None, padding=0, dilation=1, ceil_mode: bool = False,
+               return_indices: bool = False):
+    check(not return_indices, "max_pool return_indices is not supported yet")
+    return _pool_nd(a, "max", kernel_size, stride, padding, 1, ceil_mode, dilation)
+
+
+@torchsymbol("torch.nn.functional.max_pool2d")
+def max_pool2d(a, kernel_size, stride=None, padding=0, dilation=1, ceil_mode: bool = False,
+               return_indices: bool = False):
+    check(not return_indices, "max_pool return_indices is not supported yet")
+    return _pool_nd(a, "max", kernel_size, stride, padding, 2, ceil_mode, dilation)
+
+
+@torchsymbol("torch.nn.functional.max_pool3d")
+def max_pool3d(a, kernel_size, stride=None, padding=0, dilation=1, ceil_mode: bool = False,
+               return_indices: bool = False):
+    check(not return_indices, "max_pool return_indices is not supported yet")
+    return _pool_nd(a, "max", kernel_size, stride, padding, 3, ceil_mode, dilation)
+
+
+@torchsymbol("torch.nn.functional.avg_pool1d")
+def avg_pool1d(a, kernel_size, stride=None, padding=0, ceil_mode: bool = False,
+               count_include_pad: bool = True):
+    check(count_include_pad, "avg_pool count_include_pad=False is not supported yet")
+    return _pool_nd(a, "avg", kernel_size, stride, padding, 1, ceil_mode)
+
+
+@torchsymbol("torch.nn.functional.avg_pool2d")
+def avg_pool2d(a, kernel_size, stride=None, padding=0, ceil_mode: bool = False,
+               count_include_pad: bool = True, divisor_override=None):
+    check(count_include_pad, "avg_pool count_include_pad=False is not supported yet")
+    check(divisor_override is None, "avg_pool divisor_override is not supported yet")
+    return _pool_nd(a, "avg", kernel_size, stride, padding, 2, ceil_mode)
+
+
+@torchsymbol("torch.nn.functional.avg_pool3d")
+def avg_pool3d(a, kernel_size, stride=None, padding=0, ceil_mode: bool = False,
+               count_include_pad: bool = True, divisor_override=None):
+    check(count_include_pad, "avg_pool count_include_pad=False is not supported yet")
+    check(divisor_override is None, "avg_pool divisor_override is not supported yet")
+    return _pool_nd(a, "avg", kernel_size, stride, padding, 3, ceil_mode)
+
+
+def _adaptive_avg_pool(a, output_size, spatial: int):
+    out = (int(pyval(output_size)),) * spatial if isinstance(output_size, (int, NumberProxy)) else tuple(
+        int(pyval(v)) for v in output_size
+    )
+    in_sizes = tuple(a.shape[-spatial:])
+    for i, (s, o) in enumerate(zip(in_sizes, out)):
+        check(s % o == 0, lambda: f"adaptive pool requires divisible sizes, got {s}->{o}")
+    # Reshape each spatial dim (s,) -> (o, s//o) and mean the inner factor.
+    lead = tuple(a.shape[: a.ndim - spatial])
+    new_shape = lead + builtins_sum(((o, s // o) for s, o in zip(in_sizes, out)), ())
+    r = clang.reshape(a, new_shape)
+    red_dims = tuple(len(lead) + 2 * i + 1 for i in range(spatial))
+    return clang.mean(r, red_dims)
+
+
+@torchsymbol("torch.nn.functional.adaptive_avg_pool1d")
+def adaptive_avg_pool1d(a, output_size):
+    return _adaptive_avg_pool(a, output_size, 1)
+
+
+@torchsymbol("torch.nn.functional.adaptive_avg_pool2d")
+def adaptive_avg_pool2d(a, output_size):
+    return _adaptive_avg_pool(a, output_size, 2)
+
+
+@torchsymbol("torch.nn.functional.adaptive_avg_pool3d")
+def adaptive_avg_pool3d(a, output_size):
+    return _adaptive_avg_pool(a, output_size, 3)
+
+
+# =============================================================================
+# Padding
+# =============================================================================
+
+
+@torchsymbol("torch.nn.functional.pad")
+def pad(a, pad, mode: str = "constant", value=None):
+    """F.pad: ``pad`` pairs run last-dim-first. constant lowers to the pad
+    prim (XLA pad, negative = crop); reflect/replicate/circular decompose to
+    slice+flip+cat per dim."""
+    pad = tuple(int(pyval(p)) for p in pad)
+    check(len(pad) % 2 == 0, "pad takes (lo, hi) pairs")
+    npairs = len(pad) // 2
+    check(npairs <= a.ndim, "more pad pairs than dims")
+    if mode == "constant":
+        cfg = []
+        pairs = list(zip(pad[0::2], pad[1::2]))  # last dim first
+        for i in range(a.ndim):
+            j = a.ndim - 1 - i
+            if j < npairs:
+                lo, hi = pairs[j]
+                cfg.append((lo, hi, 0))
+            else:
+                cfg.append((0, 0, 0))
+        return prims.pad(a, 0 if value is None else value, tuple(cfg))
+
+    check(mode in ("reflect", "replicate", "circular"), lambda: f"Unknown pad mode {mode}")
+    r = a
+    for j in range(npairs):
+        lo, hi = pad[2 * j], pad[2 * j + 1]
+        if lo == 0 and hi == 0:
+            continue
+        d = r.ndim - 1 - j
+        n = r.shape[d]
+        check(lo >= 0 and hi >= 0, "negative padding only supported in constant mode")
+        pieces = []
+        if mode == "circular":
+            check(lo <= n and hi <= n, "circular pad wider than dim")
+            if lo:
+                pieces.append(clang.slice_in_dim(r, n - lo, n, dim=d))
+            pieces.append(r)
+            if hi:
+                pieces.append(clang.slice_in_dim(r, 0, hi, dim=d))
+        elif mode == "replicate":
+            if lo:
+                edge = clang.slice_in_dim(r, 0, 1, dim=d)
+                shape = list(edge.shape)
+                shape[d] = lo
+                pieces.append(clang.expand(edge, tuple(shape)))
+            pieces.append(r)
+            if hi:
+                edge = clang.slice_in_dim(r, n - 1, n, dim=d)
+                shape = list(edge.shape)
+                shape[d] = hi
+                pieces.append(clang.expand(edge, tuple(shape)))
+        else:  # reflect
+            check(lo < n and hi < n, "reflect pad must be < dim size")
+            if lo:
+                pieces.append(clang.flip(clang.slice_in_dim(r, 1, lo + 1, dim=d), (d,)))
+            pieces.append(r)
+            if hi:
+                pieces.append(clang.flip(clang.slice_in_dim(r, n - 1 - hi, n - 1, dim=d), (d,)))
+        r = clang.cat(pieces, d) if len(pieces) > 1 else pieces[0]
+    return r
+
+
+# =============================================================================
+# One-hot / normalization / interpolation
+# =============================================================================
+
+
+@torchsymbol("torch.nn.functional.one_hot")
+def one_hot(a, num_classes: int = -1):
+    check(int(pyval(num_classes)) > 0, "one_hot requires an explicit num_classes under tracing")
+    C = int(pyval(num_classes))
+    cols = clang.arange(0, C, 1, device=a.device, dtype=dtypes.int64)
+    shape_ones = (1,) * a.ndim
+    cols = clang.reshape(cols, shape_ones + (C,))
+    return clang.maybe_convert_to_dtype(
+        clang.eq(clang.unsqueeze(a, a.ndim), cols), dtypes.int64
+    )
+
+
+@torchsymbol("torch.nn.functional.normalize")
+def normalize(a, p: float = 2.0, dim: int = 1, eps: float = 1e-12):
+    n = norm(a, p, dim, True)
+    return clang.true_divide(a, clang.clamp(n, eps, None))
+
+
+@torchsymbol(id="torch.batch_norm_stats")
+def _batch_norm_stats(input, running_mean=None, running_var=None, weight=None, bias=None,
+                      training: bool = False, momentum: float = 0.1, eps: float = 1e-5):
+    """Functional batch_norm returning (out, new_running_mean, new_running_var)
+    — the user-facing wrapper (``batch_norm``) forwards the running-stat
+    proxies so buffer mutation functionalizes (reference: F.batch_norm's
+    in-place running-stat update + epilogue replay, jit_ext.py:1302)."""
+    check(input.ndim >= 2, "batch_norm expects (N, C, ...)")
+    C = input.shape[1]
+    red = (0,) + tuple(range(2, input.ndim))
+    stat_shape = (1, C) + (1,) * (input.ndim - 2)
+    compute_dtype = dtypes.float32 if input.dtype in (dtypes.bfloat16, dtypes.float16) else input.dtype
+    x = clang.maybe_convert_to_dtype(input, compute_dtype)
+
+    use_batch_stats = training or running_mean is None
+    if use_batch_stats:
+        var_b, mean = clang.var_mean(x, red, correction=0, keepdim=False)
+        new_mean, new_var = None, None
+        if training and running_mean is not None:
+            m = float(pyval(momentum))
+            n_elem = 1
+            for d in red:
+                n_elem *= input.shape[d]
+            var_unbiased = clang.mul(var_b, n_elem / builtins_max(n_elem - 1, 1))
+            new_mean = clang.add(clang.mul(clang.maybe_convert_to_dtype(mean, running_mean.dtype), m),
+                                 clang.mul(running_mean, 1.0 - m))
+            new_var = clang.add(clang.mul(clang.maybe_convert_to_dtype(var_unbiased, running_var.dtype), m),
+                                clang.mul(running_var, 1.0 - m))
+        use_mean, use_var = mean, var_b
+    else:
+        use_mean = clang.maybe_convert_to_dtype(running_mean, compute_dtype)
+        use_var = clang.maybe_convert_to_dtype(running_var, compute_dtype)
+        new_mean, new_var = None, None
+
+    normed = clang.mul(
+        clang.sub(x, clang.reshape(use_mean, stat_shape)),
+        clang.rsqrt(clang.add(clang.reshape(use_var, stat_shape), eps)),
+    )
+    normed = clang.maybe_convert_to_dtype(normed, input.dtype)
+    if weight is not None:
+        normed = clang.mul(normed, clang.reshape(weight, stat_shape))
+    if bias is not None:
+        normed = clang.add(normed, clang.reshape(bias, stat_shape))
+    return normed, new_mean, new_var
+
+
+def batch_norm(input, running_mean=None, running_var=None, weight=None, bias=None,
+               training: bool = False, momentum: float = 0.1, eps: float = 1e-5):
+    out, new_mean, new_var = _batch_norm_stats(
+        input, running_mean, running_var, weight, bias, training, momentum, eps
+    )
+    if new_mean is not None and isinstance(running_mean, TensorProxy):
+        _mark_inplace(running_mean, new_mean)
+    if new_var is not None and isinstance(running_var, TensorProxy):
+        _mark_inplace(running_var, new_var)
+    return out
+
+
+for _path in ("torch.nn.functional.batch_norm", "torch.batch_norm"):
+    _obj = _resolve_torch_attr(_path)
+    if _obj is not None:
+        _torch_to_thunder_function_map[_obj] = batch_norm
+
+
+@torchsymbol("torch.nn.functional.instance_norm")
+def instance_norm(input, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats: bool = True, momentum: float = 0.1, eps: float = 1e-5):
+    check(running_mean is None and running_var is None,
+          "instance_norm running stats are not supported yet")
+    check(use_input_stats, "instance_norm requires use_input_stats without running stats")
+    check(input.ndim >= 3, "instance_norm expects (N, C, ...)")
+    red = tuple(range(2, input.ndim))
+    compute_dtype = dtypes.float32 if input.dtype in (dtypes.bfloat16, dtypes.float16) else input.dtype
+    x = clang.maybe_convert_to_dtype(input, compute_dtype)
+    v, m = clang.var_mean(x, red, correction=0, keepdim=True)
+    normed = clang.maybe_convert_to_dtype(
+        clang.mul(clang.sub(x, m), clang.rsqrt(clang.add(v, eps))), input.dtype
+    )
+    C = input.shape[1]
+    stat_shape = (1, C) + (1,) * (input.ndim - 2)
+    if weight is not None:
+        normed = clang.mul(normed, clang.reshape(weight, stat_shape))
+    if bias is not None:
+        normed = clang.add(normed, clang.reshape(bias, stat_shape))
+    return normed
+
+
+def _resize_dim(x, d: int, out_size: int, mode: str, align_corners: bool):
+    L = x.shape[d]
+    if out_size == L:
+        return x
+    if mode == "nearest":
+        i = clang.arange(0, out_size, 1, device=x.device, dtype=dtypes.float32)
+        idx = clang.maybe_convert_to_dtype(clang.floor(clang.mul(i, L / out_size)), dtypes.int64)
+        return prims.take(x, idx, d)
+    # linear
+    i = clang.arange(0, out_size, 1, device=x.device, dtype=dtypes.float32)
+    if align_corners and out_size > 1:
+        src = clang.mul(i, (L - 1) / (out_size - 1))
+    else:
+        src = clang.clamp(clang.sub(clang.mul(clang.add(i, 0.5), L / out_size), 0.5), 0.0, float(L - 1))
+    i0f = clang.floor(src)
+    w = clang.sub(src, i0f)
+    i0 = clang.maybe_convert_to_dtype(i0f, dtypes.int64)
+    i1 = clang.clamp(clang.add(i0, 1), 0, L - 1)
+    x0 = prims.take(x, i0, d)
+    x1 = prims.take(x, i1, d)
+    wshape = [1] * x.ndim
+    wshape[d] = out_size
+    w = clang.reshape(w, tuple(wshape))
+    w = clang.maybe_convert_to_dtype(w, x0.dtype) if dtypes.is_float_dtype(x0.dtype) else w
+    return clang.add(x0, clang.mul(clang.sub(x1, x0), w))
+
+
+@torchsymbol("torch.nn.functional.interpolate")
+def interpolate(a, size=None, scale_factor=None, mode: str = "nearest",
+                align_corners: Optional[bool] = None, recompute_scale_factor=None,
+                antialias: bool = False):
+    check(not antialias, "interpolate antialias is not supported yet")
+    spatial = a.ndim - 2
+    check(spatial >= 1, "interpolate expects (N, C, ...) input")
+    check(mode in ("nearest", "linear", "bilinear", "trilinear"),
+          lambda: f"interpolate mode {mode} is not supported yet")
+    if size is not None:
+        out = (int(pyval(size)),) * spatial if isinstance(size, (int, NumberProxy)) else tuple(
+            int(pyval(s)) for s in size
+        )
+    else:
+        check(scale_factor is not None, "interpolate needs size or scale_factor")
+        sf = (float(pyval(scale_factor)),) * spatial if isinstance(scale_factor, (int, float, NumberProxy)) else tuple(
+            float(pyval(s)) for s in scale_factor
+        )
+        out = tuple(int(math.floor(a.shape[2 + i] * sf[i])) for i in range(spatial))
+    interp_mode = "nearest" if mode == "nearest" else "linear"
+    ac = bool(align_corners) if align_corners is not None else False
+    r = a
+    for i in range(spatial):
+        r = _resize_dim(r, 2 + i, out[i], interp_mode, ac)
+    return r
+
+
+# =============================================================================
+# Additional activations
+# =============================================================================
+
+
+@torchsymbol("torch.nn.functional.glu")
+def glu(a, dim: int = -1):
+    d = canonicalize_dim(a.ndim, int(pyval(dim)))
+    n = a.shape[d]
+    check(n % 2 == 0, "glu dim must be even")
+    x = clang.slice_in_dim(a, 0, n // 2, dim=d)
+    g = clang.slice_in_dim(a, n // 2, n, dim=d)
+    return clang.mul(x, sigmoid(g))
+
+
+@torchsymbol("torch.nn.functional.hardtanh")
+def hardtanh(a, min_val: float = -1.0, max_val: float = 1.0, inplace: bool = False):
+    return clang.clamp(a, min_val, max_val)
+
+
+@torchsymbol("torch.nn.functional.relu6")
+def relu6(a, inplace: bool = False):
+    return clang.clamp(a, 0.0, 6.0)
+
+
+@torchsymbol("torch.nn.functional.hardsigmoid")
+def hardsigmoid(a, inplace: bool = False):
+    return clang.true_divide(clang.clamp(clang.add(a, 3.0), 0.0, 6.0), 6.0)
+
+
+@torchsymbol("torch.nn.functional.logsigmoid")
+def logsigmoid(a):
+    # -softplus(-x), stable.
+    return clang.neg(softplus(clang.neg(a)))
+
+
+@torchsymbol("torch.nn.functional.selu")
+def selu(a, inplace: bool = False):
+    alpha = 1.6732632423543772848170429916717
+    scale = 1.0507009873554804934193349852946
+    return clang.mul(scale, clang.where(clang.gt(a, 0), a, clang.mul(alpha, clang.expm1(a))))
+
+
+@torchsymbol("torch.nn.functional.celu")
+def celu(a, alpha: float = 1.0, inplace: bool = False):
+    return clang.where(clang.gt(a, 0), a, clang.mul(alpha, clang.expm1(clang.true_divide(a, alpha))))
+
+
+@torchsymbol("torch.nn.functional.prelu")
+def prelu(a, weight):
+    if weight.numel > 1:
+        wshape = [1] * a.ndim
+        if a.ndim >= 2:
+            wshape[1] = weight.numel
+        weight = clang.reshape(weight, tuple(wshape))
+    return clang.where(clang.gt(a, 0), a, clang.mul(a, weight))
+
+
+@torchsymbol("torch.nn.functional.softmin")
+def softmin(a, dim: int, dtype=None):
+    return softmax(clang.neg(a), dim, dtype)
+
+
+@torchsymbol("torch.nn.functional.softsign")
+def softsign(a):
+    return clang.true_divide(a, clang.add(clang.abs(a), 1.0))
+
+
+@torchsymbol("torch.nn.functional.tanhshrink")
+def tanhshrink(a):
+    return clang.sub(a, clang.tanh(a))
+
+
+@torchsymbol("torch.nn.functional.hardshrink")
+def hardshrink(a, lambd: float = 0.5):
+    keep = clang.gt(clang.abs(a), lambd)
+    return clang.where(keep, a, clang.zeros_like(a))
+
+
+@torchsymbol("torch.nn.functional.softshrink")
+def softshrink(a, lambd: float = 0.5):
+    mag = clang.sub(clang.abs(a), lambd)
+    return clang.where(clang.gt(clang.abs(a), lambd), clang.mul(clang.sign(a), mag), clang.zeros_like(a))
+
+
+@torchsymbol("torch.nn.functional.threshold")
+def threshold(a, threshold_: float, value: float, inplace: bool = False):
+    return clang.where(clang.gt(a, threshold_), a, clang.full_like(a, value))
+
+
+# =============================================================================
+# Additional losses
+# =============================================================================
+
+
+def _reduce_loss(l, reduction: str):
+    if reduction == "none":
+        return l
+    if reduction == "sum":
+        return clang.sum(l, None)
+    check(reduction == "mean", lambda: f"Unknown reduction {reduction}")
+    return clang.mean(l, None)
+
+
+@torchsymbol("torch.nn.functional.l1_loss")
+def l1_loss(input, target, reduction: str = "mean"):
+    return _reduce_loss(clang.abs(clang.sub(input, target)), reduction)
+
+
+@torchsymbol("torch.nn.functional.smooth_l1_loss")
+def smooth_l1_loss(input, target, reduction: str = "mean", beta: float = 1.0):
+    d = clang.abs(clang.sub(input, target))
+    quad = clang.true_divide(clang.mul(clang.mul(d, d), 0.5), beta)
+    lin = clang.sub(d, 0.5 * beta)
+    return _reduce_loss(clang.where(clang.lt(d, beta), quad, lin), reduction)
+
+
+@torchsymbol("torch.nn.functional.huber_loss")
+def huber_loss(input, target, reduction: str = "mean", delta: float = 1.0):
+    d = clang.abs(clang.sub(input, target))
+    quad = clang.mul(clang.mul(d, d), 0.5)
+    lin = clang.mul(delta, clang.sub(d, 0.5 * delta))
+    return _reduce_loss(clang.where(clang.lt(d, delta), quad, lin), reduction)
+
+
+@torchsymbol("torch.nn.functional.binary_cross_entropy")
+def binary_cross_entropy(input, target, weight=None, reduction: str = "mean"):
+    eps = 1e-12
+    l = clang.neg(clang.add(
+        clang.mul(target, clang.log(clang.clamp(input, eps, None))),
+        clang.mul(clang.sub(1.0, target), clang.log(clang.clamp(clang.sub(1.0, input), eps, None))),
+    ))
+    if weight is not None:
+        l = clang.mul(l, weight)
+    return _reduce_loss(l, reduction)
+
+
+@torchsymbol("torch.nn.functional.binary_cross_entropy_with_logits")
+def binary_cross_entropy_with_logits(input, target, weight=None, pos_weight=None,
+                                     reduction: str = "mean"):
+    # max(x,0) - x*t + log(1+exp(-|x|)) — the numerically stable form.
+    neg_abs = clang.neg(clang.abs(input))
+    if pos_weight is None:
+        base = clang.add(clang.sub(clang.maximum(input, 0), clang.mul(input, target)),
+                         clang.log1p(clang.exp(neg_abs)))
+    else:
+        # loss = (1-t)*x + (1+(pw-1)*t) * softplus(-x), with
+        # softplus(-x) = log1p(exp(-|x|)) - min(x, 0)  (stable).
+        lw = clang.add(1.0, clang.mul(clang.sub(pos_weight, 1.0), target))
+        softplus_neg = clang.sub(clang.log1p(clang.exp(neg_abs)), clang.minimum(input, 0))
+        base = clang.add(clang.mul(clang.sub(1.0, target), input), clang.mul(lw, softplus_neg))
+    l = base
+    if weight is not None:
+        l = clang.mul(l, weight)
+    return _reduce_loss(l, reduction)
+
+
+@torchsymbol("torch.nn.functional.kl_div")
+def kl_div(input, target, reduction: str = "mean", log_target: bool = False):
+    if log_target:
+        l = clang.mul(clang.exp(target), clang.sub(target, input))
+    else:
+        l = clang.sub(xlogy(target, target), clang.mul(target, input))
+    if reduction == "batchmean":
+        return clang.true_divide(clang.sum(l, None), input.shape[0])
+    return _reduce_loss(l, reduction)
+
+
+# =============================================================================
+# In-place ops (functionalized: compute out-of-place, forward the stale proxy)
+# =============================================================================
+
+
+def _mark_inplace(old, new):
+    """Functionalize an in-place update: cast the result back to the target's
+    dtype (torch in-place ops keep self's dtype), register forwarding so every
+    later consumer of ``old`` sees ``new``, and flag the trace so
+    Symbol.__call__ resolves proxies (reference analogue: thunder's implicit
+    functionalization of in-place torch ops)."""
+    from thunder_tpu.core.trace import get_tracectx
+
+    check(isinstance(old, TensorProxy), "in-place op target must be a traced tensor")
+    if isinstance(new, TensorProxy) and new.dtype != old.dtype:
+        new = clang.maybe_convert_to_dtype(new, old.dtype)
+    if isinstance(new, TensorProxy) and tuple(new.shape) != tuple(old.shape):
+        new = clang.expand_to(new, tuple(old.shape))
+    trc = get_tracectx()
+    if trc is not None:
+        trc._inplace_seen = True
+        targets = getattr(trc, "_inplace_targets", None)
+        if targets is None:
+            targets = trc._inplace_targets = {}
+        # Keyed by the ORIGINAL proxy so module epilogues can map a
+        # param/buffer to its final value after any number of updates.
+        targets[old.name] = old
+    old._inplace_forward = new
+    return new
+
+
+def _inplace(name: str, functional: Callable):
+    def impl(a, *args, **kwargs):
+        return _mark_inplace(a, functional(a, *args, **kwargs))
+
+    impl.__name__ = name
+    obj = _resolve_torch_attr(f"torch.Tensor.{name}")
+    if obj is not None:
+        _torch_to_thunder_function_map[obj] = impl
+    _torch_ctx.register_method(name, impl)
+    return impl
+
+
+add_ = _inplace("add_", add)
+sub_ = _inplace("sub_", sub)
+mul_ = _inplace("mul_", mul)
+div_ = _inplace("div_", div_sym)
+pow_ = _inplace("pow_", pow)
+neg_ = _inplace("neg_", clang.neg)
+abs_ = _inplace("abs_", clang.abs)
+exp_ = _inplace("exp_", clang.exp)
+log_ = _inplace("log_", clang.log)
+sqrt_ = _inplace("sqrt_", clang.sqrt)
+rsqrt_ = _inplace("rsqrt_", clang.rsqrt)
+sigmoid_ = _inplace("sigmoid_", lambda a: sigmoid(a))
+tanh_ = _inplace("tanh_", clang.tanh)
+relu_ = _inplace("relu_", lambda a: clang.maximum(a, 0))
+floor_ = _inplace("floor_", clang.floor)
+ceil_ = _inplace("ceil_", clang.ceil)
+round_ = _inplace("round_", clang.round)
+trunc_ = _inplace("trunc_", clang.trunc)
+erf_ = _inplace("erf_", clang.erf)
+zero_ = _inplace("zero_", lambda a: clang.zeros_like(a))
+fill_ = _inplace("fill_", lambda a, v: clang.full_like(a, v))
+masked_fill_ = _inplace("masked_fill_", masked_fill)
+clamp_ = _inplace("clamp_", clang.clamp)
+clamp_min_ = _inplace("clamp_min_", lambda a, m: clang.clamp(a, m, None))
+clamp_max_ = _inplace("clamp_max_", lambda a, m: clang.clamp(a, None, m))
+copy_ = _inplace("copy_", lambda a, src, non_blocking=False: src)
+addcmul_ = _inplace("addcmul_", addcmul)
+addcdiv_ = _inplace("addcdiv_", addcdiv)
+lerp_ = _inplace("lerp_", lerp)
+tril_ = _inplace("tril_", tril)
+triu_ = _inplace("triu_", triu)
+scatter_add_ = _inplace("scatter_add_", scatter_add)
+index_add_ = _inplace("index_add_", index_add)
+index_copy_ = _inplace("index_copy_", index_copy)
+uniform_ = _inplace(
+    "uniform_",
+    lambda a, from_=0.0, to=1.0, generator=None: clang.uniform(
+        tuple(a.shape), float(pyval(from_)), float(pyval(to)), device=a.device,
+        dtype=a.dtype if dtypes.is_float_dtype(a.dtype) else dtypes.float32,
+    ),
+)
+normal_ = _inplace(
+    "normal_",
+    lambda a, mean=0.0, std=1.0, generator=None: clang.add(
+        clang.mul(
+            clang.randn(tuple(a.shape), device=a.device,
+                        dtype=a.dtype if dtypes.is_float_dtype(a.dtype) else dtypes.float32),
+            std,
+        ),
+        mean,
+    ),
+)
+
+
+def _requires_grad_(a, requires_grad: bool = True):
+    a._requires_grad = bool(requires_grad) and dtypes.is_inexact_dtype(a.dtype)
+    return a
+
+
+def _detach_(a):
+    return _mark_inplace(a, prims.stop_gradient(a))
+
+
+_torch_ctx.register_method("requires_grad_", _requires_grad_)
+_torch_ctx.register_method("detach_", _detach_)
+for _nm, _fn in (("requires_grad_", _requires_grad_), ("detach_", _detach_)):
+    _obj = _resolve_torch_attr(f"torch.Tensor.{_nm}")
+    if _obj is not None:
+        _torch_to_thunder_function_map[_obj] = _fn
 
 
 # =============================================================================

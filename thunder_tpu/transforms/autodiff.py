@@ -165,6 +165,7 @@ register_nondiff(
     PrimIDs.BITWISE_LEFT_SHIFT,
     PrimIDs.BITWISE_RIGHT_SHIFT,
     PrimIDs.EMBEDDING_BACKWARD,
+    PrimIDs.CONVOLUTION_BWD,
     PrimIDs.UNIFORM_PHILOX,
     PrimIDs.POOL_BWD,
     PrimIDs.IMAG,
@@ -467,6 +468,18 @@ def _cumsum_vjp(bsym, g):
     return (clang.flip(prims.cumsum(clang.flip(g, (dim,)), dim), (dim,)), None)
 
 
+@register_vjp(PrimIDs.CUMPROD)
+def _cumprod_vjp(bsym, g):
+    # Standard reverse-scan formula: dL/da_i = (sum_{j>=i} g_j * out_j) / a_i.
+    # Matches torch autograd's fast path; like it, undefined where a == 0.
+    a, dim = bsym.args
+    if not _is_float_tensor(a):
+        return (None, None)
+    out = bsym.output
+    w = clang.flip(prims.cumsum(clang.flip(clang.mul(g, out), (dim,)), dim), (dim,))
+    return (clang.true_divide(w, a), None)
+
+
 # =============================================================================
 # Rules: reductions
 # =============================================================================
@@ -611,6 +624,22 @@ def _linear_vjp(bsym, g):
     if bias is not None and _is_float_tensor(bias):
         gbias = clang.sum(g, tuple(range(g.ndim - 1)))
     return (ga, gw, gbias)
+
+
+@register_vjp(PrimIDs.CONVOLUTION)
+def _convolution_vjp(bsym, g):
+    a, w, bias, stride, padding, dilation, groups = bsym.args
+    da, dw = prims.convolution_bwd(g, a, w, stride, padding, dilation, groups)
+    db = None
+    if bias is not None and _is_float_tensor(bias):
+        # bias broadcasts over (N, *spatial); channel dim is 1.
+        db = clang.sum(g, (0,) + tuple(range(2, g.ndim)))
+    return (
+        da if _is_float_tensor(a) else None,
+        dw if _is_float_tensor(w) else None,
+        db,
+        None, None, None, None,
+    )
 
 
 @register_vjp(PrimIDs.EMBEDDING)
