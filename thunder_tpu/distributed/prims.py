@@ -87,7 +87,7 @@ def _sync_is_sharded(a, parallel_type: Optional[str]) -> bool:
 
 def _synchronize_meta(
     a: TensorProxy, axis: str, group_size: int, parallel_type: Optional[str] = None,
-    *, grad_scale: Optional[float] = None,
+    *, grad_scale: Optional[float] = None, grad_sync: bool = True,
 ):
     """FULLY_SHARDED params enter dim-0-sharded and synchronize to the full
     tensor (all-gather); REPLICATED params pass through. The VJP rule holds
@@ -95,7 +95,13 @@ def _synchronize_meta(
 
     ``parallel_type`` ("fsdp" | "replicated") records the decision as a
     static arg so the runtime lowering doesn't depend on trace-time proxy
-    attributes; None falls back to the proxy's dist_parallel_type."""
+    attributes; None falls back to the proxy's dist_parallel_type.
+
+    ``grad_sync=False`` compiles the `no_sync` variant (reference:
+    thunder/distributed/__init__.py:27-67): the VJP emits the scaled LOCAL
+    grad with no collective — for fsdp params that grad is full-size
+    (unsharded), matching the reference's no_sync-accumulates-unsharded-grads
+    behavior; the deferred sync reduces at context exit."""
     from thunder_tpu.core.proxies import DistParallelType
 
     if _sync_is_sharded(a, parallel_type):
@@ -176,7 +182,7 @@ def _register_jax_impls():
             r = r / group_size
         return r
 
-    def sync(a, axis, group_size, parallel_type=None, *, grad_scale=None):
+    def sync(a, axis, group_size, parallel_type=None, *, grad_scale=None, grad_sync=True):
         # FSDP shards all-gather to the full param; replicated params pass
         # through (their sync semantics live entirely in the VJP's grad
         # all-reduce). None = legacy call sites that always gather.
@@ -260,6 +266,12 @@ def _register_vjps():
         if scale is None:
             scale = 1.0 / group_size
         scaled = clang.mul(g, scale) if scale != 1.0 else g
+        if bsym.kwargs.get("grad_sync", True) is False:
+            # no_sync: keep the scaled local grad, defer the collective to
+            # context exit (sum over the device axis there). For fsdp the
+            # local grad stays FULL-size — the reduce_scatter that would
+            # shard it is exactly the skipped sync.
+            return (scaled, None, None)
         if _sync_is_sharded(a, ptype):
             # FSDP: grad of the gathered param reduce-scatters back to shards
             # (reference: prims.py:286-298).

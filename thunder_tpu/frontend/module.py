@@ -20,6 +20,7 @@ torch optimizer works unchanged.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Optional
 
 from thunder_tpu.core.proxies import TensorProxy
@@ -199,6 +200,9 @@ class ThunderModule:
 
         self._params: dict[str, Any] = {}  # qual name → jax array
         self._requires_grad: dict[str, bool] = {}
+        # no_sync grad accumulation: qual → (ndev, *grad_shape) jax array,
+        # device-sharded along dim 0; reduced into .grad by _sync_grads().
+        self._nosync_accum: dict[str, Any] = {}
         # (id, torch._version) per param: in-place updates (optimizer.step)
         # bump _version, wholesale replacement changes id — either marks the
         # jax copy stale and __call__ re-bridges it (ADVICE r1: without this,
@@ -319,10 +323,41 @@ class ThunderModule:
     def original_module(self):
         return self._module
 
+    @contextlib.contextmanager
     def no_sync(self):
+        """Gradient accumulation: backward passes inside the context compile
+        without grad collectives (per-device local grads accumulate on
+        device); leaving the context performs the deferred sync into
+        ``param.grad`` (reference: thunder/__init__.py:197-239 +
+        distributed/__init__.py:27-70 `_sync_grads`). Backwards must run
+        inside the context."""
         from thunder_tpu.distributed import no_sync
 
-        return no_sync()
+        with no_sync():
+            yield
+        self._sync_grads()
+
+    def _sync_grads(self) -> None:
+        """Reduce accumulated no-sync local grads over the device axis and
+        add them onto ``param.grad``. The in-trace VJP already applied
+        grad_scale, so the deferred collective is a plain SUM — the same
+        reduction the synced backward's all_reduce/reduce_scatter performs."""
+        if not self._nosync_accum:
+            return
+        import torch
+
+        from thunder_tpu.executors import bridge
+
+        named = dict(_named_qual_tensors(self._module))
+        for qual, stacked in self._nosync_accum.items():
+            owner = named.get(qual)
+            if owner is None:
+                continue
+            total = stacked.sum(axis=0)
+            with torch.no_grad():
+                tg = bridge.to_torch(total).to(owner.dtype)
+                owner.grad = tg if owner.grad is None else owner.grad + tg
+        self._nosync_accum.clear()
 
     # -- compilation ----------------------------------------------------------
 
@@ -339,6 +374,14 @@ class ThunderModule:
         module = self._module
         dist_n = self._dist_axis_size()
         dist_axis = self._dist["axis"] if self._dist_active() else None
+
+        # no_sync variant (reference: distributed/__init__.py:27-70): the
+        # contextvar changes COMPILATION — synchronize records grad_sync=False
+        # so the backward carries no grad collectives; the variant caches
+        # under its own key (see _cache_key).
+        from thunder_tpu.distributed import skip_data_parallel_grad_sync
+
+        nosync = dist_axis is not None and skip_data_parallel_grad_sync()
 
         # Under an active dist config the staged function runs inside
         # shard_map: each device sees the LOCAL dim-0 shard of every
@@ -438,7 +481,8 @@ class ThunderModule:
                             p.dist_parallel_type = DistParallelType.REPLICATED
                             ptype = "replicated"
                         synced[qual] = dist_prims.synchronize(
-                            p, dist_axis, dist_n, ptype, grad_scale=grad_scale
+                            p, dist_axis, dist_n, ptype, grad_scale=grad_scale,
+                            grad_sync=not nosync,
                         )
                     else:
                         synced[qual] = p
@@ -574,17 +618,20 @@ class ThunderModule:
                 return _P()
             return dim0_spec(p.ndim)
 
-        def stage(trc, out_specs, in_specs=None) -> Any:
+        def stage(trc, out_specs, in_specs=None, wrap=None) -> Any:
             """jax.jit for single-device; shard_map over the mesh when a
             ddp/fsdp config is active (collectives in the trace reference
             the mesh axis by name)."""
+            fn = trc.python_callable()
+            if wrap is not None:
+                fn = wrap(fn)
             if dist_axis is None:
-                return jax.jit(trc.python_callable())
+                return jax.jit(fn)
             from thunder_tpu.distributed.runtime import shard_map_callable
 
             if in_specs is None:
                 in_specs = tuple(spec_of(a) for a in trc.args)
-            return shard_map_callable(trc.python_callable(), self._dist["mesh"], in_specs, out_specs)
+            return shard_map_callable(fn, self._dist["mesh"], in_specs, out_specs)
 
         has_updates = isinstance(comp.output, dict) and "__updates" in comp.output
 
@@ -599,7 +646,19 @@ class ThunderModule:
             if self._jit_options.get("rematerialize", True):
                 from thunder_tpu.transforms.rematerialization import rematerialize_forward_and_backward
 
-                fw, bw = rematerialize_forward_and_backward(fw, bw)
+                # ZeRO-3 (reference: FSDPType.ZERO3 + rematerialization.py:389):
+                # param all-gathers are recomputed in backward from the saved
+                # dim-0 shard instead of saving the gathered full parameter.
+                # ZERO2 keeps the gathered param saved (no re-gather).
+                from thunder_tpu.distributed import FSDPType
+
+                zero3 = (
+                    self._dist is not None
+                    and self._dist.get("mode") == "fsdp"
+                    and self._dist.get("fsdp_type", FSDPType.ZERO3) is FSDPType.ZERO3
+                    and dist_n > 1
+                )
+                fw, bw = rematerialize_forward_and_backward(fw, bw, remat_collectives=zero3)
             fw_ex = transform_for_execution(fw, executors)
             bw_ex = transform_for_execution(bw, executors)
 
@@ -622,9 +681,18 @@ class ThunderModule:
                 bw_out_specs = []
                 for kind, which in wrt_kinds:
                     if kind == "param":
-                        bw_out_specs.append(
-                            dim0_spec(ndim_of[which]) if which in sharded_quals else _P()
-                        )
+                        if nosync:
+                            # Per-device local grads (full-size for fsdp)
+                            # stacked along a fresh leading device axis by
+                            # the bw wrapper; each device contributes its
+                            # slice — no collective anywhere.
+                            bw_out_specs.append(
+                                _P(dist_axis, *([None] * trace_params[which].ndim))
+                            )
+                        else:
+                            bw_out_specs.append(
+                                dim0_spec(ndim_of[which]) if which in sharded_quals else _P()
+                            )
                     else:
                         p = rg_input_proxies[which]
                         bw_out_specs.append(
@@ -634,12 +702,27 @@ class ThunderModule:
         except _FallbackReplicated:
             return self._compile(args, kwargs, _force_replicated_data=True)
 
+        bw_wrap = None
+        if nosync and dist_axis is not None:
+            param_positions = tuple(i for i, (k, _) in enumerate(wrt_kinds) if k == "param")
+
+            def bw_wrap(fn, _pos=param_positions):
+                def stacked(*a):
+                    gs = list(fn(*a))
+                    for i in _pos:
+                        gs[i] = gs[i][None]
+                    return tuple(gs)
+
+                return stacked
+
         return {
             "fwd": stage(fw_ex, fw_out_specs),
-            "bwd": stage(bw_ex, bw_out_specs, bw_in_specs),
+            "bwd": stage(bw_ex, bw_out_specs, bw_in_specs, wrap=bw_wrap),
             "wrt_kinds": wrt_kinds,
             "traces": [comp, fw_ex, bw_ex],
             "has_updates": has_updates,
+            "nosync": nosync,
+            "accum": self._nosync_accum,
         }
 
     def _cache_key(self, args: tuple, kwargs: dict):
@@ -651,8 +734,11 @@ class ThunderModule:
                 return (tuple(shape), dev.split(":")[0], str(dt), rg)
             return x if isinstance(x, (int, float, bool, str, type(None))) else type(x).__name__
 
+        from thunder_tpu.distributed import skip_data_parallel_grad_sync
+
         flat, spec = tree_flatten((args, kwargs))
-        return (tuple(leaf_key(x) for x in flat), str(spec))
+        nosync = self._dist_active() and skip_data_parallel_grad_sync()
+        return (tuple(leaf_key(x) for x in flat), str(spec), nosync)
 
     # -- call -----------------------------------------------------------------
 
@@ -749,6 +835,12 @@ def _run_thunder_function(entry: dict, flat_inputs: list, input_tensors: list, p
             for (kind, which), g in zip(entry["wrt_kinds"], grads):
                 if kind == "input":
                     out_grads.append((which, bridge.to_torch(g)))
+                elif entry.get("nosync"):
+                    # Accumulate the stacked per-device local grads on
+                    # device; ThunderModule._sync_grads reduces them into
+                    # .grad at no_sync context exit.
+                    acc = entry["accum"]
+                    acc[which] = g if which not in acc else acc[which] + g
                 else:
                     owner = param_of.get(which)
                     if owner is not None:

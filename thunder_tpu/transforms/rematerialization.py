@@ -25,7 +25,9 @@ from thunder_tpu.transforms.common import dce
 
 # Ops worth recomputing: one VPU pass, fused by XLA into whatever consumes
 # them. Everything else (MXU ops, reductions, gathers, RNG, collectives)
-# stays saved.
+# stays saved — except param-gather collectives under ZeRO-3, which
+# `remat_collectives=True` marks recomputable (reference:
+# rematerialization.py:389 `rematerialize_all_gather`).
 _CHEAP_TAGS = {OpTags.ELEMENTWISE_UNARY_OP, OpTags.ELEMENTWISE_BINARY_OP, OpTags.SHAPE_OP}
 _CHEAP_IDS = {
     PrimIDs.CONVERT_ELEMENT_TYPE,
@@ -45,11 +47,20 @@ def _is_cheap(bsym) -> bool:
     return any(t in _CHEAP_TAGS for t in bsym.sym.tags)
 
 
-def rematerialize_forward_and_backward(fw_trace: TraceCtx, bw_trace: TraceCtx):
+def rematerialize_forward_and_backward(
+    fw_trace: TraceCtx, bw_trace: TraceCtx, *, remat_collectives: bool = False
+):
     """Shrink saved-for-backward by recomputing cheap chains in backward.
 
     Returns (new_fw, new_bw). fw's output structure stays
     ``(outputs, saved_tuple)``; bw's args stay ``saved... + cotangents...``.
+
+    ``remat_collectives=True`` is the ZeRO-3 seat (reference:
+    rematerialization.py:389 + torch_autograd.py:224-228): a param-gathering
+    collective (`synchronize`/`all_gather`) whose input is a trace arg (the
+    dim-0 shard) counts as recomputable, so the backward re-gathers from the
+    shard instead of saving the full parameter — the cut then saves shard
+    bytes (free: the shard is already an input) instead of full-param bytes.
     """
     start = time.perf_counter_ns()
 
@@ -65,6 +76,21 @@ def rematerialize_forward_and_backward(fw_trace: TraceCtx, bw_trace: TraceCtx):
     arg_proxies = {a.name: a for a in fw_trace.args if isinstance(a, TensorProxy)}
     fw_out_flat, _ = _fw_primal_outputs(fw_trace)
 
+    if remat_collectives:
+        from thunder_tpu.distributed.prims import DistOpIDs
+
+        _gather_ids = {DistOpIDs.SYNCHRONIZE, DistOpIDs.ALL_GATHER}
+
+        def is_cheap(bsym) -> bool:
+            if _is_cheap(bsym):
+                return True
+            if bsym.sym.id in _gather_ids:
+                a = next(iter(bsym.flat_proxy_args), None)
+                return a is not None and a.name in arg_proxies
+            return False
+    else:
+        is_cheap = _is_cheap
+
     # Closure analysis: name → (chain bsyms in topo order, frontier names) or None.
     memo: dict[str, Optional[tuple]] = {}
 
@@ -75,7 +101,7 @@ def rematerialize_forward_and_backward(fw_trace: TraceCtx, bw_trace: TraceCtx):
             memo[name] = ([], {name})
             return memo[name]
         bsym = producers.get(name)
-        if bsym is None or not _is_cheap(bsym):
+        if bsym is None or not is_cheap(bsym):
             memo[name] = None  # must be saved / is a frontier
             return None
         chain: list = []
@@ -119,7 +145,7 @@ def rematerialize_forward_and_backward(fw_trace: TraceCtx, bw_trace: TraceCtx):
                 return True
             visiting.add(n)
             b = producers.get(n)
-            if b is None or not _is_cheap(b):
+            if b is None or not is_cheap(b):
                 return False
             for a in b.flat_proxy_args:
                 if not walk(a.name):
@@ -132,7 +158,7 @@ def rematerialize_forward_and_backward(fw_trace: TraceCtx, bw_trace: TraceCtx):
 
     keep: list[str] = []
     recompute: dict[str, tuple] = {}
-    cut_set = _min_cut_saved_set(saved_names, producers, arg_proxies, closure, size_of)
+    cut_set = _min_cut_saved_set(saved_names, producers, arg_proxies, closure, size_of, is_cheap)
 
     if cut_set is not None:
         # Min-cut chose the optimal save boundary (possibly mid-chain).
@@ -230,7 +256,7 @@ def rematerialize_forward_and_backward(fw_trace: TraceCtx, bw_trace: TraceCtx):
     return new_fw, new_bw
 
 
-def _min_cut_saved_set(saved_names, producers, arg_proxies, closure, size_of):
+def _min_cut_saved_set(saved_names, producers, arg_proxies, closure, size_of, is_cheap=_is_cheap):
     """Optimal save boundary via s-t min cut (reference:
     rematerialization.py:245 — igraph max-flow; here the in-repo C++ Dinic,
     thunder_tpu/csrc/mincut.cpp, with a Python fallback).
@@ -288,7 +314,7 @@ def _min_cut_saved_set(saved_names, producers, arg_proxies, closure, size_of):
         if name in targets:
             edges.append((vo, 1, INF_CAP))
         b = producers.get(name)
-        if name not in seeds and name not in arg_proxies and b is not None and _is_cheap(b):
+        if name not in seeds and name not in arg_proxies and b is not None and is_cheap(b):
             for a in b.flat_proxy_args:
                 if a.name in idx:
                     edges.append((idx[a.name] + 1, vi, INF_CAP))
