@@ -15,6 +15,8 @@ from thunder_tpu.core.pytree import tree_flatten
 
 
 def _flat(x):
+    if isinstance(x, tuple) and type(x) is not tuple:
+        x = tuple(x)  # torch.return_types.* structseq → plain tuple (opaque to jax pytrees)
     flat, _ = tree_flatten(x)
     return [v for v in flat if isinstance(v, torch.Tensor) or hasattr(v, "shape") or isinstance(v, (int, float, bool))]
 

@@ -232,8 +232,16 @@ def to_jax_dtype(d: dtype) -> Any:
 
 
 def finfo_max(d: dtype) -> float:
-    """Largest finite value of a float dtype (torch.finfo(d).max parity)."""
-    return float(np.finfo(to_jax_dtype(to_strong(d))).max)
+    """Largest finite value of a float dtype (torch.finfo(d).max parity).
+    numpy's finfo rejects ml_dtypes (bfloat16, fp8) on this numpy version —
+    ml_dtypes.finfo handles both those and the standard floats."""
+    jd = to_jax_dtype(to_strong(d))
+    try:
+        return float(np.finfo(jd).max)
+    except ValueError:
+        import ml_dtypes
+
+        return float(ml_dtypes.finfo(jd).max)
 
 
 def from_jax_dtype(jd: Any) -> dtype:

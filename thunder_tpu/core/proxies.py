@@ -127,6 +127,11 @@ class AnyProxy(Proxy):
 
 
 class StringProxy(Proxy):
+    """A string input observed during tracing. Behaves like its value for
+    comparison/containment so mode/reduction flags (``reduction == "mean"``,
+    ``"->" in equation``) take the right branch instead of silently failing
+    an identity comparison."""
+
     _counter_prefix = "s"
 
     def __init__(self, value: str, name: Optional[str] = None):
@@ -135,6 +140,24 @@ class StringProxy(Proxy):
 
     def replace_name(self, name: str) -> "StringProxy":
         return StringProxy(self.value, name=name)
+
+    def __eq__(self, other) -> bool:
+        return self.value == (other.value if isinstance(other, StringProxy) else other)
+
+    def __hash__(self) -> int:
+        return hash(self.value)
+
+    def __str__(self) -> str:
+        return self.value
+
+    def __contains__(self, item) -> bool:
+        return item in self.value
+
+    def __iter__(self):
+        return iter(self.value)
+
+    def __len__(self) -> int:
+        return len(self.value)
 
 
 class CollectionProxy(Proxy):
@@ -209,47 +232,58 @@ class NumberProxy(Proxy):
     # Arithmetic dunders route through the active language so the ops are
     # recorded when symbolic-values mode arrives; with known values they
     # constant-fold at trace time.
-    def _number_binop(self, other, op: Callable, name: str):
+    def _number_binop(self, other, op: Callable, name: str, *, reflected: bool = False):
         ovalue = other.value if isinstance(other, NumberProxy) else other
         if self.value is not None and ovalue is not None:
             return op(self.value, ovalue)
         method = resolve_method(name, self, other)
         if method is not None:
-            return method(self, other)
+            # Reflected dunders (__radd__ etc.) mean `other OP self` — the
+            # recorded op's operand order must match.
+            return method(other, self) if reflected else method(self, other)
         raise RuntimeError(f"Cannot compute {name} on unknown numbers without a language method")
 
     def __add__(self, other):
         return self._number_binop(other, lambda a, b: a + b, "add")
 
     def __radd__(self, other):
-        return self._number_binop(other, lambda a, b: b + a, "add")
+        return self._number_binop(other, lambda a, b: b + a, "add", reflected=True)
 
     def __sub__(self, other):
         return self._number_binop(other, lambda a, b: a - b, "sub")
 
     def __rsub__(self, other):
-        return self._number_binop(other, lambda a, b: b - a, "sub")
+        return self._number_binop(other, lambda a, b: b - a, "sub", reflected=True)
 
     def __mul__(self, other):
         return self._number_binop(other, lambda a, b: a * b, "mul")
 
     def __rmul__(self, other):
-        return self._number_binop(other, lambda a, b: b * a, "mul")
+        return self._number_binop(other, lambda a, b: b * a, "mul", reflected=True)
 
     def __truediv__(self, other):
         return self._number_binop(other, lambda a, b: a / b, "true_divide")
 
     def __rtruediv__(self, other):
-        return self._number_binop(other, lambda a, b: b / a, "true_divide")
+        return self._number_binop(other, lambda a, b: b / a, "true_divide", reflected=True)
 
     def __floordiv__(self, other):
         return self._number_binop(other, lambda a, b: a // b, "floor_divide")
 
+    def __rfloordiv__(self, other):
+        return self._number_binop(other, lambda a, b: b // a, "floor_divide", reflected=True)
+
     def __mod__(self, other):
         return self._number_binop(other, lambda a, b: a % b, "remainder")
 
+    def __rmod__(self, other):
+        return self._number_binop(other, lambda a, b: b % a, "remainder", reflected=True)
+
     def __pow__(self, other):
         return self._number_binop(other, lambda a, b: a**b, "pow")
+
+    def __rpow__(self, other):
+        return self._number_binop(other, lambda a, b: b**a, "pow", reflected=True)
 
     def __neg__(self):
         if self.value is not None:

@@ -429,8 +429,77 @@ def _pool_fwd_fn(a, kind, window, strides, padding):
 
 
 def _pool_bwd_fn(g, a, kind, window, strides, padding):
-    _, vjp = jax.vjp(lambda x: _pool_fwd_fn(x, kind, window, strides, padding), a)
-    return vjp(g)[0]
+    """Direct pooling adjoints (this jax build cannot differentiate
+    reduce_window under jit at all — Linearization failure — so jax.vjp is
+    not an option here).
+
+    avg: the transpose of a strided window-sum is a stride-1 window-sum over
+    the base-dilated cotangent (XLA's own transpose rule), divided by the
+    window size. max: torch semantics (grad to the FIRST max element of each
+    window) via a single int64 reduce_window over (monotonic-value, reversed-
+    index) packed keys, then scatter-add of g at each window's argmax."""
+    k = len(window)
+    lead = a.shape[: a.ndim - k]
+    spatial = a.shape[a.ndim - k:]
+
+    if kind == "avg":
+        full_window = (1,) * (a.ndim - k) + tuple(window)
+        pads = []
+        for s_in, kk, tt, (lo, hi) in zip(spatial, window, strides, padding):
+            d = (g.shape[g.ndim - k + len(pads)] - 1) * tt + 1
+            pl = kk - 1 - lo
+            ph = s_in + lo - d
+            pads.append((pl, ph))
+        full_pads = ((0, 0),) * (a.ndim - k) + tuple(pads)
+        base_dil = (1,) * (a.ndim - k) + tuple(strides)
+        adj = lax.reduce_window(
+            g, jnp.asarray(0, g.dtype), lax.add, full_window, (1,) * a.ndim,
+            full_pads, base_dilation=base_dil,
+        )
+        return adj / math.prod(window)
+
+    # max: pack (monotonic value bits, reversed linear index) into int64 so a
+    # single reduce_window max yields each window's first-argmax index. The
+    # packing needs real int64 — enable x64 locally so the adjoint works even
+    # when the caller never went through jit()'s _ensure_runtime.
+    with jax.enable_x64():
+        return _max_pool_bwd_x64(g, a, window, strides, padding, lead, spatial)
+
+
+def _max_pool_bwd_x64(g, a, window, strides, padding, lead, spatial):
+    k = len(window)
+    n_spatial = math.prod(spatial)
+    b = math.prod(lead) if lead else 1
+    if a.dtype == jnp.float64:
+        # The packed argmax key holds 32 value bits; two f64 values inside a
+        # window that differ only below f32 precision would pick the wrong
+        # winner and silently misroute the whole cotangent. Refuse rather
+        # than be subtly wrong (torch-parity surface is f32/bf16 pooling).
+        raise NotImplementedError(
+            "max-pool backward for float64 inputs is not supported (argmax "
+            "key packing is exact only to float32); cast to float32"
+        )
+    af = a.astype(jnp.float32) if a.dtype != jnp.float32 else a
+    bits = lax.bitcast_convert_type(af, jnp.int32).astype(jnp.int64)
+    mono = jnp.where(bits < 0, ~bits, bits | jnp.int64(0x80000000))
+    # Center to [-2^31, 2^31) so the <<32 below cannot overflow int64.
+    mono = mono - (jnp.int64(1) << 31)
+    idx = jnp.arange(n_spatial, dtype=jnp.int64).reshape((1,) * len(lead) + spatial)
+    packed = (mono << 32) | (jnp.int64(n_spatial) - idx)  # larger = earlier index
+    full_window = (1,) * (a.ndim - k) + tuple(window)
+    full_strides = (1,) * (a.ndim - k) + tuple(strides)
+    full_pad = ((0, 0),) * (a.ndim - k) + tuple((int(lo), int(hi)) for lo, hi in padding)
+    winner = lax.reduce_window(
+        jnp.broadcast_to(packed, a.shape), jnp.iinfo(jnp.int64).min, lax.max,
+        full_window, full_strides, full_pad,
+    )
+    win_idx = jnp.int64(n_spatial) - (winner & jnp.int64(0xFFFFFFFF))
+    flat_idx = win_idx.reshape(b, -1)
+    flat_g = g.reshape(b, -1)
+    grad = jnp.zeros((b, n_spatial), g.dtype).at[
+        jnp.arange(b)[:, None], flat_idx
+    ].add(flat_g)
+    return grad.reshape(a.shape)
 
 
 _reg(PrimIDs.POOL, _pool_fwd_fn)

@@ -19,6 +19,7 @@ branches, reductions/matmuls the MXU can tile.
 
 from __future__ import annotations
 
+import functools
 import math
 from numbers import Number
 from typing import Any, Callable, Optional, Sequence, Union
@@ -28,7 +29,7 @@ import thunder_tpu.core.prims as prims
 from thunder_tpu.core import dtypes, devices, utils
 from thunder_tpu.core.baseutils import check
 from thunder_tpu.core.langctxs import LanguageContext, Languages, register_langctx, resolve_language
-from thunder_tpu.core.proxies import NumberProxy, TensorProxy, pyval
+from thunder_tpu.core.proxies import AnyProxy, NumberProxy, StringProxy, TensorProxy, pyval
 from thunder_tpu.core.symbol import Symbol, register_module
 from thunder_tpu.core.utils import canonicalize_dim, canonicalize_dims
 
@@ -60,20 +61,50 @@ def _resolve_torch_attr(path: str):
     return obj
 
 
+def _unproxy_static(x):
+    """Replace static-valued scalar/string/opaque input proxies with their
+    concrete values, recursively through containers.
+
+    Exact under CONSTANT_VALUES caching: the prologue guards every number/
+    string input value, so the computation is already specialized to them —
+    recording the value (not the proxy) in the bound symbol keeps dims,
+    mode strings, slices etc. out of the generated program's free variables.
+    NumberProxies with *unknown* values (e.g. `.item()` outputs — genuinely
+    dynamic) are preserved."""
+    if isinstance(x, (tuple, list)):
+        return type(x)(_unproxy_static(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _unproxy_static(v) for k, v in x.items()}
+    if isinstance(x, NumberProxy):
+        return x.value if x.value is not None else x
+    if isinstance(x, (StringProxy, AnyProxy)):
+        return x.value
+    return x
+
+
 def torchsymbol(*torch_paths: str, method_name: Optional[str] = None, id: Optional[str] = None):
     """Create an ltorch Symbol from a decomposition fn, registering it under
     the given torch dotted paths and optionally as a tensor method
-    (reference: thunder/torch `torchsymbol:73`)."""
+    (reference: thunder/torch `torchsymbol:73`).
+
+    The registered callable unwraps static scalar/string input proxies at
+    the op boundary (see ``_unproxy_static``) before recording the symbol."""
 
     def decorator(fn: Callable) -> Symbol:
         sym = Symbol(fn.__name__, meta=fn, id=id if id is not None else f"torch.{fn.__name__}", module="ltorch")
+
+        @functools.wraps(fn)
+        def op(*args, **kwargs):
+            return sym(*_unproxy_static(args), **_unproxy_static(kwargs))
+
+        op._symbol = sym
         for path in torch_paths:
             obj = _resolve_torch_attr(path)
             if obj is not None:
-                _torch_to_thunder_function_map[obj] = sym
+                _torch_to_thunder_function_map[obj] = op
         if method_name is not None:
-            _torch_ctx.register_method(method_name, sym)
-        return sym
+            _torch_ctx.register_method(method_name, op)
+        return op
 
     return decorator
 

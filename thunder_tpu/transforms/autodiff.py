@@ -403,11 +403,24 @@ def _pad_vjp(bsym, g):
     a, _, config = bsym.args
     if not _is_float_tensor(a):
         return (None, None, None)
+    # Negative lo/hi crop the input — the cropped elements' grad is zero, so
+    # zero-pad the cotangent back out before slicing (slice starts must be
+    # non-negative).
+    pre_pad = []
     starts, ends, strides = [], [], []
-    for s, (lo, hi, dil) in zip(a.shape, config):
-        starts.append(lo)
-        ends.append(lo + (s - 1) * (dil + 1) + 1 if s > 0 else lo)
-        strides.append(dil + 1)
+    needs_pre = False
+    for gs, s, (lo, hi, dil) in zip(g.shape, a.shape, config):
+        d1 = dil + 1
+        p = max(0, -int(lo))
+        end = int(lo) + (s - 1) * d1 + 1 if s > 0 else int(lo)
+        q = max(0, end - int(gs))
+        pre_pad.append((p, q, 0))
+        needs_pre = needs_pre or p or q
+        starts.append(p + int(lo))
+        ends.append(p + end)
+        strides.append(d1)
+    if needs_pre:
+        g = prims.pad(g, 0.0, tuple(pre_pad))
     return (prims.slice_prim(g, starts, ends, strides), None, None)
 
 
@@ -454,10 +467,11 @@ def _gather_vjp(bsym, g):
 
 @register_vjp(PrimIDs.SCATTER_ADD)
 def _scatter_add_vjp(bsym, g):
+    # Prim signature is (a, indices, value, dim) — grads must align.
     a, idx, val, dim = bsym.args
     ga = g if _is_float_tensor(a) else None
     gv = prims.gather(g, idx, dim) if _is_float_tensor(val) else None
-    return (ga, gv, None, None)
+    return (ga, None, gv, None)
 
 
 @register_vjp(PrimIDs.CUMSUM)
