@@ -1,0 +1,97 @@
+"""Trace pattern matcher (reference: thunder/core/patterns.py:19,364)."""
+
+import numpy as np
+
+import thunder_tpu.clang as clang
+from thunder_tpu.api import trace_program
+from thunder_tpu.core.patterns import Match, Pattern, replace
+from thunder_tpu.core.prims import PrimIDs
+from thunder_tpu.executors.passes import transform_for_execution
+from thunder_tpu.extend import resolve_executors
+from thunder_tpu.transforms.common import dce
+
+
+def _trace(fn, *args):
+    _, comp = trace_program(fn, args, {})
+    return dce(comp)
+
+
+class TestPattern:
+    def test_match_chain(self):
+        def f(a, b):
+            return clang.neg(clang.add(clang.mul(a, b), a))
+
+        x = np.random.randn(3).astype(np.float32)
+        comp = _trace(f, x, x)
+        ms = Pattern().match(PrimIDs.MUL, "m").match(PrimIDs.ADD, "a").match_all(comp)
+        assert len(ms) == 1
+        m = ms[0]
+        assert m["m"].sym.id is PrimIDs.MUL and m["a"].sym.id is PrimIDs.ADD
+        # The add consumes the mul's output (connected dataflow).
+        assert m["m"].flat_proxy_outs[0].name in {p.name for p in m["a"].flat_proxy_args}
+
+    def test_predicate_step_and_no_match(self):
+        def f(a):
+            return clang.mul(clang.neg(a), 2.0)
+
+        x = np.random.randn(3).astype(np.float32)
+        comp = _trace(f, x)
+        assert not Pattern().match(PrimIDs.ADD).match_all(comp)
+        ms = Pattern().match(lambda b: b.sym.id is PrimIDs.NEG, "n").match_all(comp)
+        assert len(ms) == 1 and isinstance(ms[0], Match)
+
+    def test_non_overlapping(self):
+        def f(a):
+            t = clang.mul(a, 2.0)
+            u = clang.mul(t, 3.0)
+            v = clang.mul(u, 4.0)
+            return v
+
+        x = np.random.randn(3).astype(np.float32)
+        comp = _trace(f, x)
+        # mul→mul matches twice would overlap at the middle op; expect 1
+        # non-overlapping chain match starting at the first mul.
+        ms = Pattern().match(PrimIDs.MUL).match(PrimIDs.MUL).match_all(comp)
+        assert len(ms) == 1
+        assert ms[0].indices[0] < ms[0].indices[1]
+
+    def test_replace_refuses_dangling_consumer(self):
+        """An unmatched op consuming a matched intermediate without a
+        remapping must be refused, not silently produce a broken trace."""
+        import pytest
+
+        def f(a):
+            t = clang.mul(a, 2.0)
+            u = clang.neg(t)  # unmatched consumer of the matched mul
+            v = clang.add(t, a)
+            return clang.mul(u, v)
+
+        x = np.random.randn(3).astype(np.float32)
+        comp = _trace(f, x)
+        m = Pattern().match(PrimIDs.MUL, "m").match(PrimIDs.ADD, "a").match_all(comp)[0]
+
+        def build(match):
+            a_in = match["m"].args[0]
+            return {match["a"].flat_proxy_outs[0].name: clang.mul(a_in, 3.0)}
+
+        with pytest.raises(ValueError, match="consumes"):
+            replace(comp, m, build)
+
+    def test_replace_rewrite(self):
+        """Peephole: a*b + a → a*(b+1), numerically verified end-to-end."""
+
+        def f(a, b):
+            return clang.neg(clang.add(clang.mul(a, b), a))
+
+        x = np.random.randn(3).astype(np.float32)
+        comp = _trace(f, x, x)
+        m = Pattern().match(PrimIDs.MUL, "m").match(PrimIDs.ADD, "a").match_all(comp)[0]
+
+        def build(match):
+            a_in, b_in = match["m"].args[0], match["m"].args[1]
+            return {match["a"].flat_proxy_outs[0].name: clang.mul(a_in, clang.add(b_in, 1.0))}
+
+        comp2 = dce(replace(comp, m, build))
+        ex = transform_for_execution(comp2, resolve_executors(None))
+        got = ex.python_callable()(x, x)
+        np.testing.assert_allclose(np.asarray(got), -(x * (x + 1.0)), rtol=1e-6)

@@ -32,4 +32,10 @@ from thunder_tpu.api import (  # noqa: F401
     cache_misses,
     set_execution_callback_file,
 )
+from thunder_tpu.common import (  # noqa: F401
+    CACHE_OPTIONS,
+    SHARP_EDGES_OPTIONS,
+    ThunderSharpEdgeError,
+    ThunderSharpEdgeWarning,
+)
 

@@ -21,10 +21,14 @@ from typing import Any, Callable, Optional, Sequence
 from thunder_tpu import clang  # registers the clang language  # noqa: F401
 from thunder_tpu.common import (
     CACHE_OPTIONS,
+    SHARP_EDGES_OPTIONS,
     CacheEntry,
     CompileData,
     CompileStats,
     resolve_cache_option,
+    resolve_sharp_edges_option,
+    sharp_edge,
+    sharp_edges_policy,
     timer_ns,
 )
 from thunder_tpu.core import dtypes, prims
@@ -48,7 +52,7 @@ from thunder_tpu.executors import flashex, pallasex  # higher-priority kernel ex
 from thunder_tpu.executors import quantex  # opt-in int8 executor (registered, not default)  # noqa: F401
 from thunder_tpu.executors.passes import del_last_used, transform_for_execution
 from thunder_tpu.extend import resolve_executors
-from thunder_tpu.transforms.common import dce
+from thunder_tpu.transforms.common import cse, dce
 from thunder_tpu.transforms.rng import RNG_TAG, functionalize_rng_ops
 
 
@@ -128,7 +132,14 @@ def _build_prologue(
                 prims.check_string_value(p, p.value)
             elif isinstance(p, AnyProxy) and p.value is None:
                 prims.check_none(p)
-            # other AnyProxy: unguarded (sharp edge)
+            else:
+                # Unguardable leaf: its observed value is baked into the
+                # trace with no prologue check — report per the sharp-edges
+                # policy (reference: jit_ext.py `_general_jit_sharp_edge:468`).
+                sharp_edge(
+                    f"input {getattr(p, 'name', p)!r} of type "
+                    f"{type(getattr(p, 'value', concrete)).__name__} cannot be guarded"
+                )
 
         def unpack_into(coll_proxy: CollectionProxy, concrete: Any, proxied: Any) -> None:
             if isinstance(concrete, (tuple, list)):
@@ -239,11 +250,14 @@ def _compile_entry(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict)
     import jax
 
     cs.last_trace_tracing_start = timer_ns()
-    plg_trc, comp_trc = trace_program(cd.fn, args, kwargs)
+    with sharp_edges_policy(cd.sharp_edges):
+        plg_trc, comp_trc = trace_program(cd.fn, args, kwargs)
     cs.last_trace_tracing_stop = timer_ns()
 
     computation_traces = [comp_trc]
     comp_trc = dce(comp_trc)
+    computation_traces.append(comp_trc)
+    comp_trc = cse(comp_trc)
     computation_traces.append(comp_trc)
 
     # Trace-to-trace transforms requested at jit() time (grad, autocast, ...).
@@ -362,12 +376,33 @@ def _ensure_runtime() -> None:
     if not jax.config.jax_enable_x64:
         jax.config.update("jax_enable_x64", True)
 
+    # Persistent XLA compilation cache (reference analogue: nvFuser's
+    # descriptor-keyed compiled-fusion cache, SURVEY.md §2.2 — here the
+    # cache survives processes, so warm-start recompiles of the same
+    # program are file reads, not 80-second XLA runs). Opt out with
+    # THUNDER_TPU_NO_COMPILE_CACHE=1.
+    import os
+
+    if not os.environ.get("THUNDER_TPU_NO_COMPILE_CACHE"):
+        cache_dir = os.environ.get(
+            "THUNDER_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/thunder_tpu_xla")
+        )
+        try:
+            if not jax.config.jax_compilation_cache_dir:
+                os.makedirs(cache_dir, exist_ok=True)
+                jax.config.update("jax_compilation_cache_dir", cache_dir)
+                jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+                jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception:
+            pass  # older jax without the persistent-cache config
+
 
 def jit(
     fn: Optional[Callable] = None,
     *,
     executors: Optional[Sequence] = None,
     cache: str | CACHE_OPTIONS = CACHE_OPTIONS.CONSTANT_VALUES,
+    sharp_edges: str | SHARP_EDGES_OPTIONS = SHARP_EDGES_OPTIONS.ALLOW,
     disable_jit_staging: bool = False,
     **compile_options,
 ) -> Callable:
@@ -382,6 +417,7 @@ def jit(
             jit,
             executors=executors,
             cache=cache,
+            sharp_edges=sharp_edges,
             disable_jit_staging=disable_jit_staging,
             **compile_options,
         )
@@ -408,13 +444,15 @@ def jit(
         from thunder_tpu.frontend.module import thunder_module
 
         return thunder_module(
-            fn, executors=executors, cache=cache, disable_jit_staging=disable_jit_staging, **compile_options
+            fn, executors=executors, cache=cache, sharp_edges=sharp_edges,
+            disable_jit_staging=disable_jit_staging, **compile_options
         )
 
     cd = CompileData(
         fn=fn,
         executors_list=resolve_executors(executors),
         cache_option=resolve_cache_option(cache),
+        sharp_edges=resolve_sharp_edges_option(sharp_edges),
         disable_jit_staging=disable_jit_staging,
         compile_options=dict(compile_options),
     )

@@ -7,6 +7,8 @@ Reference parity: thunder/common.py (`CompileData:138`, `CompileStats:54`,
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import enum
 import time
 from dataclasses import dataclass, field
@@ -42,6 +44,64 @@ class SHARP_EDGES_OPTIONS(enum.Enum):
     ALLOW = enum.auto()
     WARN = enum.auto()
     ERROR = enum.auto()
+
+
+_string_to_sharp_edges = {
+    "allow": SHARP_EDGES_OPTIONS.ALLOW,
+    "warn": SHARP_EDGES_OPTIONS.WARN,
+    "error": SHARP_EDGES_OPTIONS.ERROR,
+}
+
+
+def resolve_sharp_edges_option(x: Any) -> SHARP_EDGES_OPTIONS:
+    if isinstance(x, SHARP_EDGES_OPTIONS):
+        return x
+    if isinstance(x, str):
+        opt = _string_to_sharp_edges.get(x.lower())
+        if opt is not None:
+            return opt
+    raise ValueError(f"Unknown sharp_edges option {x!r} (allow|warn|error)")
+
+
+class ThunderSharpEdgeWarning(UserWarning):
+    """A tracing-unsafe construct was observed (reference:
+    thunder/core/options.py:146 + jit_ext.py `_general_jit_sharp_edge:468`)."""
+
+
+class ThunderSharpEdgeError(RuntimeError):
+    """sharp_edges='error': a tracing-unsafe construct was observed."""
+
+
+_sharp_edges_policy = contextvars.ContextVar(
+    "sharp_edges_policy", default=SHARP_EDGES_OPTIONS.ALLOW
+)
+
+
+def sharp_edge(msg: str) -> None:
+    """Report a tracing-unsafe construct per the active policy. ALLOW is
+    silent (the reference's default); WARN emits ThunderSharpEdgeWarning;
+    ERROR raises ThunderSharpEdgeError."""
+    policy = _sharp_edges_policy.get()
+    if policy is SHARP_EDGES_OPTIONS.ALLOW:
+        return
+    full = (
+        f"sharp edge: {msg}. The trace specializes on the observed value; "
+        f"changes to it will NOT recompile. Pass sharp_edges='allow' to silence."
+    )
+    if policy is SHARP_EDGES_OPTIONS.ERROR:
+        raise ThunderSharpEdgeError(full)
+    import warnings
+
+    warnings.warn(full, ThunderSharpEdgeWarning, stacklevel=3)
+
+
+@contextlib.contextmanager
+def sharp_edges_policy(policy: SHARP_EDGES_OPTIONS):
+    tok = _sharp_edges_policy.set(policy)
+    try:
+        yield
+    finally:
+        _sharp_edges_policy.reset(tok)
 
 
 @dataclass

@@ -100,7 +100,11 @@ class TraceCtx:
 
     # -- codegen -------------------------------------------------------------
 
-    def python(self, *, print_depth: int = 1, include_header: bool = True) -> str:
+    def python(self, *, print_depth: int = 1, include_header: bool = True, annotate: bool = False) -> str:
+        """Render the trace as Python source. ``annotate=True`` wraps each
+        value-producing op in ``jax.named_scope`` so op names flow into HLO
+        metadata and profiler timelines (reference: thunder/core/profile.py:15
+        `add_markers` via torch.profiler/NVTX, env THUNDER_ANNOTATE_TRACES)."""
         lines: list[str] = []
         if include_header:
             if self.provenance is not None:
@@ -111,7 +115,11 @@ class TraceCtx:
         lines.append(self.siginfo.prettyprint())
         body: list[str] = []
         for bsym in self.bound_symbols:
-            body.extend(bsym.python(indent=1, print_depth=print_depth))
+            if annotate and bsym.flat_proxy_outs:
+                body.append(f"{baseutils.indent(1)}with __annotate_scope({bsym.sym.name!r}):")
+                body.extend(bsym.python(indent=2, print_depth=print_depth))
+            else:
+                body.extend(bsym.python(indent=1, print_depth=print_depth))
         if not body:
             body = [f"{baseutils.indent(1)}pass"]
         lines.extend(body)
@@ -142,8 +150,15 @@ class TraceCtx:
         return ctx
 
     def python_callable(self, **exec_ctx) -> Callable:
-        source = self.python(include_header=False)
+        import os
+
+        annotate = os.environ.get("THUNDER_ANNOTATE_TRACES", "").lower() not in ("", "0", "false", "off")
+        source = self.python(include_header=False, annotate=annotate)
         ctx = self.gen_ctx()
+        if annotate:
+            import jax
+
+            ctx["__annotate_scope"] = jax.named_scope
         ctx.update(exec_ctx)
         fn = baseutils.compile_and_exec(self.siginfo.name, source, ctx)
         fn.__thunder_trace__ = self

@@ -32,12 +32,8 @@ def examine(fn: Callable, *args, **kwargs) -> dict:
     try:
         if isinstance(fn, torch.nn.Module):
             tm = ThunderModule(fn)
-            _, comp = tm._trace_forward_for_examine(args, kwargs) if hasattr(
-                tm, "_trace_forward_for_examine"
-            ) else (None, None)
-            if comp is None:
-                entry = tm._compile(args, kwargs)
-                comp = entry["traces"][0]
+            entry = tm._compile(args, kwargs)
+            comp = entry["traces"][0]
         else:
             _, comp = trace_program(fn, args, kwargs)
         report["supported"] = True
