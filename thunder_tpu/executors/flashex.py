@@ -88,15 +88,17 @@ def _flash(q, k, v, *, causal: bool, sm_scale: float):
     from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes, flash_attention
 
     S = q.shape[-2]
-    # Bigger blocks amortize the online-softmax bookkeeping: 512 measured
-    # 1.6× faster than 128 at S=2048 on v5e (block sweep in commit history).
+    # Bigger blocks amortize the online-softmax bookkeeping: fwd 512 measured
+    # 1.6× faster than 128 at S=2048 on v5e (block sweep in commit history);
+    # bwd 512 vs 256 cut the open_llama_3b train step 0.888→0.807 s/iter
+    # (train MFU 0.482→0.530, r3 ablations). 1024 measured neutral vs 512.
     def fit(pref):
         b = min(pref, S)
         while S % b:
             b //= 2
         return max(b, 1)
 
-    b, bb = fit(512), fit(256)
+    b, bb = fit(512), fit(512)
     sizes = BlockSizes(
         block_q=b, block_k_major=b, block_k=b, block_b=1,
         block_q_major_dkv=bb, block_k_major_dkv=bb, block_k_dkv=bb, block_q_dkv=bb,

@@ -190,9 +190,23 @@ class ThunderModule:
     """Compiled wrapper around a torch.nn.Module (reference: __init__.py:178)."""
 
     def __init__(self, module, **jit_options):
+        from thunder_tpu.common import CompileData, CompileStats
+
         self._module = module
         self._jit_options = jit_options
         self._cache: dict[Any, dict] = {}
+
+        # Introspection parity (reference: thunder/__init__.py:697-793):
+        # jitted modules carry the same CompileData/CompileStats the
+        # functional frontend does, so thunder_tpu.last_traces(tm) /
+        # cache_hits(tm) / compile_stats(tm) work on the flagship frontend.
+        self._lc_cd = CompileData(
+            fn=module,
+            executors_list=tuple(jit_options.get("executors") or ()),
+            is_module=True,
+            compile_options=dict(jit_options),
+        )
+        self._lc_cs = CompileStats()
 
         # ddp()/fsdp() tag the torch module before jit (reference workflow
         # `fsdp(model); thunder.jit(model)`, thunder/distributed/__init__.py:303).
@@ -330,11 +344,21 @@ class ThunderModule:
         device); leaving the context performs the deferred sync into
         ``param.grad`` (reference: thunder/__init__.py:197-239 +
         distributed/__init__.py:27-70 `_sync_grads`). Backwards must run
-        inside the context."""
+        inside the context.
+
+        The accumulator is cleared on entry, and on an exception the
+        half-accumulated grads are DISCARDED (not synced) — param.grad stays
+        untouched so a caught-and-retried accumulation round cannot
+        double-count the microbatches that ran before the failure."""
         from thunder_tpu.distributed import no_sync
 
-        with no_sync():
-            yield
+        self._nosync_accum.clear()
+        try:
+            with no_sync():
+                yield
+        except BaseException:
+            self._nosync_accum.clear()
+            raise
         self._sync_grads()
 
     def _sync_grads(self) -> None:
@@ -369,7 +393,7 @@ class ThunderModule:
         from thunder_tpu.executors.passes import transform_for_execution
         from thunder_tpu.extend import resolve_executors
         from thunder_tpu.transforms.autodiff import forward_and_backward_from_trace
-        from thunder_tpu.transforms.common import dce
+        from thunder_tpu.transforms.common import cse, dce
 
         module = self._module
         dist_n = self._dist_axis_size()
@@ -512,8 +536,13 @@ class ThunderModule:
                 return {"__out": _normalize_output(out), "__updates": updates}
             return _normalize_output(out)
 
-        _, comp = trace_program(functional_fwd, (trace_params,) + trace_args, trace_kwargs)
-        comp = dce(comp)
+        from thunder_tpu.common import resolve_sharp_edges_option, sharp_edges_policy
+
+        with sharp_edges_policy(
+            resolve_sharp_edges_option(self._jit_options.get("sharp_edges", "allow"))
+        ):
+            _, comp = trace_program(functional_fwd, (trace_params,) + trace_args, trace_kwargs)
+        comp = cse(dce(comp))
 
         # Mark requires_grad on the trace's tensor args. Trace args align
         # with the concrete tensor leaves of ((params, *args), kwargs) in
@@ -740,17 +769,100 @@ class ThunderModule:
         nosync = self._dist_active() and skip_data_parallel_grad_sync()
         return (tuple(leaf_key(x) for x in flat), str(spec), nosync)
 
+    # -- dynamic shapes: sequence bucketing (SURVEY §7 hard-part 5) -----------
+
+    def _apply_seq_bucketing(self, args: tuple, kwargs: dict):
+        """Pad dim 1 of every ndim>=2 tensor input up to the next multiple of
+        ``seq_bucket`` so any T in a bucket reuses ONE compiled entry — the
+        reference recompiles per exact shape and collapses on dynamic shapes
+        (5715 s, BASELINE.md); exact-shape guards are this repo's default too.
+
+        Sound for causal LMs: padded tail positions cannot influence real
+        positions under causal attention, outputs are cropped back to T along
+        dim 1, and torch autograd routes cotangents through the pad (zeros at
+        padded positions) so grads match the unpadded run. ``seq_pad_value``
+        (default 0) fills the padding — choose a token the loss ignores when
+        a target tensor is among the inputs (e.g. -100 targets need their own
+        masking strategy). Returns (args, kwargs, T, T_padded)."""
+        import torch
+
+        from thunder_tpu.core.pytree import tree_unflatten
+        from thunder_tpu.executors import bridge
+
+        bucket = self._jit_options["seq_bucket"]
+        flat, spec = tree_flatten((args, kwargs))
+        lens = {
+            int(x.shape[1])
+            for x in flat
+            if bridge.is_concrete_tensor(x) and len(x.shape) >= 2
+        }
+        if len(lens) != 1:
+            return args, kwargs, None, None  # ambiguous — exact-shape path
+        t = lens.pop()
+        t_pad = -(-t // bucket) * bucket
+        if t_pad == t:
+            return args, kwargs, t, t
+        fill = self._jit_options.get("seq_pad_value", 0)
+
+        def pad_leaf(x):
+            if not (bridge.is_concrete_tensor(x) and len(x.shape) >= 2 and x.shape[1] == t):
+                return x
+            if isinstance(x, torch.Tensor):
+                pad_shape = (x.shape[0], t_pad - t) + tuple(x.shape[2:])
+                pad = torch.full(pad_shape, fill, dtype=x.dtype, device=x.device)
+                return torch.cat([x, pad], dim=1)
+            import jax.numpy as jnp
+
+            widths = [(0, 0)] * x.ndim
+            widths[1] = (0, t_pad - t)
+            return jnp.pad(x, widths, constant_values=fill)
+
+        new_args, new_kwargs = tree_unflatten(spec, [pad_leaf(x) for x in flat])
+        return new_args, new_kwargs, t, t_pad
+
+    def _crop_seq_outputs(self, out, t: int, t_pad: int):
+        import torch
+
+        def crop(x):
+            if isinstance(x, torch.Tensor) and x.ndim >= 2 and x.shape[1] == t_pad:
+                return x.narrow(1, 0, t)
+            return x
+
+        return tree_map(crop, out)
+
     # -- call -----------------------------------------------------------------
 
     def __call__(self, *args, **kwargs):
+        if self._jit_options.get("seq_bucket"):
+            args, kwargs, t, t_pad = self._apply_seq_bucketing(args, kwargs)
+            if t is not None and t_pad != t:
+                return self._crop_seq_outputs(self._call_impl(*args, **kwargs), t, t_pad)
+        return self._call_impl(*args, **kwargs)
+
+    def _call_impl(self, *args, **kwargs):
+        from thunder_tpu.common import timer_ns
         from thunder_tpu.executors import bridge
 
         self._refresh_stale_params()
+        cs = self._lc_cs
+        cs.calls += 1
         key = self._cache_key(args, kwargs)
         entry = self._cache.get(key)
         if entry is None:
+            cs.cache_misses += 1
+            cs.last_trace_tracing_start = timer_ns()
             entry = self._compile(args, kwargs)
+            cs.last_trace_tracing_stop = timer_ns()
             self._cache[key] = entry
+        else:
+            cs.cache_hits += 1
+        traces = entry["traces"]
+        if entry["bwd"] is not None:
+            cs.last_traces = traces[:-1]
+            cs.last_backward_traces = traces[-1:]
+        else:
+            cs.last_traces = list(traces)
+            cs.last_backward_traces = []
 
         flat_concrete, _ = tree_flatten(((self._params,) + args, kwargs))
         flat_inputs = [bridge.to_jax(x) if bridge.is_concrete_tensor(x) else x for x in flat_concrete]
