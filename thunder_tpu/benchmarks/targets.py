@@ -1,0 +1,181 @@
+"""Microbenchmark targets: op units × executor matrix, pytest-runnable.
+
+Reference parity: thunder/benchmarks/targets.py (pytest-benchmark targets)
++ the executor-matrix benchmark constructions in benchmarks/__init__.py:699-976
+(GeLU/softmax/cross-entropy/SDPA units and LitGPT block benchmarks run per
+executor). Here each target compiles the op through the full jit pipeline
+under a named executor list and reports the standard harness metrics.
+
+Run as pytest (opt-in — benchmarks are not correctness CI):
+    THUNDER_BENCH=1 pytest thunder_tpu/benchmarks/targets.py -q -s
+or as a CLI:
+    python -m thunder_tpu.benchmarks.targets [--filter sdpa] [--iters 20]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from functools import partial
+
+import numpy as np
+
+import pytest
+
+
+def _enabled() -> bool:
+    return bool(os.environ.get("THUNDER_BENCH"))
+
+
+EXECUTOR_CONFIGS = {
+    "jax": ["jax"],
+    "kernels": ["flash", "pallas", "jax"],
+    "quant": ["quant", "jax"],
+}
+
+
+def _rand(*shape, dtype=np.float32, seed=0):
+    return (np.random.RandomState(seed + sum(shape)).randn(*shape) * 0.5).astype(dtype)
+
+
+# -- unit definitions: name -> (fn builder over ltorch, example args) ---------
+
+
+def _unit_gelu():
+    import thunder_tpu.torch as ltorch
+
+    x = _rand(4096, 4096)
+    return lambda a: ltorch.gelu(a), (x,), 0
+
+
+def _unit_softmax():
+    import thunder_tpu.torch as ltorch
+
+    x = _rand(256, 8192)
+    return lambda a: ltorch.softmax(a, -1), (x,), 0
+
+
+def _unit_layer_norm():
+    import thunder_tpu.torch as ltorch
+
+    x = _rand(4096, 4096)
+    w, b = _rand(4096, seed=1), _rand(4096, seed=2)
+    return lambda a, w, b: ltorch.layer_norm(a, (4096,), w, b), (x, w, b), 0
+
+
+def _unit_cross_entropy():
+    import thunder_tpu.torch as ltorch
+
+    logits = _rand(4096, 32000)
+    tgt = np.random.RandomState(3).randint(0, 32000, (4096,)).astype(np.int64)
+    return lambda a, t: ltorch.cross_entropy(a, t), (logits, tgt), 0
+
+
+def _unit_sdpa():
+    import thunder_tpu.torch as ltorch
+
+    B, H, S, D = 4, 16, 2048, 128
+    q, k, v = (_rand(B, H, S, D, seed=i).astype(np.float32) for i in range(3))
+    flops = 4.0 * B * H * S * S * D  # 2 matmuls fwd
+    return (
+        lambda q, k, v: ltorch.scaled_dot_product_attention(q, k, v, is_causal=True),
+        (q, k, v),
+        flops,
+    )
+
+
+def _unit_linear():
+    import thunder_tpu.torch as ltorch
+
+    x, w = _rand(4096, 4096), _rand(4096, 4096, seed=1)
+    return lambda a, w: ltorch.linear(a, w), (x, w), 2.0 * 4096**3
+
+
+def _unit_gpt_block_fwd():
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.models import gpt as m
+
+    cfg = m.name_to_config("pythia-160m")
+    params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+    idx = np.random.RandomState(0).randint(0, cfg.vocab_size, (4, 512)).astype(np.int32)
+    n = sum(int(np.prod(p.shape)) for p in _leaves(params))
+    return lambda p, i: m.forward(p, i, cfg), (params, idx), 2.0 * n * 4 * 512
+
+
+def _leaves(tree):
+    from thunder_tpu.core.pytree import tree_leaves
+
+    return [p for p in tree_leaves(tree) if hasattr(p, "shape")]
+
+
+UNITS = {
+    "gelu": _unit_gelu,
+    "softmax": _unit_softmax,
+    "layer_norm": _unit_layer_norm,
+    "cross_entropy": _unit_cross_entropy,
+    "sdpa": _unit_sdpa,
+    "linear": _unit_linear,
+    "gpt_block_fwd": _unit_gpt_block_fwd,
+}
+
+
+def run_target(unit: str, executor: str, *, iters: int = 10, warmup: int = 2) -> dict:
+    import jax
+
+    import thunder_tpu
+    from thunder_tpu.benchmarks import run_benchmark
+    from thunder_tpu.core.pytree import tree_map
+
+    fn, args, flops = UNITS[unit]()
+    # Device-resident inputs: a numpy arg would re-upload through the axon
+    # tunnel (~35 MB/s measured) every iteration and swamp the op time.
+    args = tree_map(
+        lambda x: jax.device_put(x) if isinstance(x, np.ndarray) else x, args
+    )
+    jfn = thunder_tpu.jit(fn, executors=EXECUTOR_CONFIGS[executor])
+    result = run_benchmark(
+        f"{unit}[{executor}]",
+        partial(jfn, *args),
+        warmup=warmup,
+        iters=iters,
+        flops_per_iter=flops or None,
+        pipelined=True,
+    )
+    return result.summary()
+
+
+# -- pytest targets (gated: benchmarks are not correctness CI) ----------------
+
+
+@pytest.mark.parametrize("executor", list(EXECUTOR_CONFIGS))
+@pytest.mark.parametrize("unit", list(UNITS))
+def test_bench(unit, executor):
+    if not _enabled():
+        pytest.skip("set THUNDER_BENCH=1 to run benchmark targets")
+    summary = run_target(unit, executor)
+    print(json.dumps(summary))
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--filter", default="")
+    p.add_argument("--executors", default=",".join(EXECUTOR_CONFIGS))
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+
+    for unit in UNITS:
+        if args.filter and args.filter not in unit:
+            continue
+        for executor in args.executors.split(","):
+            try:
+                summary = run_target(unit, executor, iters=args.iters)
+            except Exception as e:  # noqa: BLE001 — report and continue the matrix
+                summary = {"name": f"{unit}[{executor}]", "error": f"{type(e).__name__}: {e}"}
+            print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

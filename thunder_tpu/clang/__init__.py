@@ -865,3 +865,107 @@ def sort(a, dim: int = -1, descending: bool = False):
 @clangop()
 def argsort(a, dim: int = -1, descending: bool = False):
     return prims.argsort(a, utils.canonicalize_dim(a.ndim, dim), bool(descending))
+
+
+# -- reference-parity additions (thunder/clang public surface) ----------------
+# Guard/unpack prims are re-exported so clang covers the reference's full
+# public op list (reference: thunder/clang/__init__.py exposes check_*/
+# unpack_* used by prologue construction).
+
+check_tensor_shape_and_metadata = prims.check_tensor_shape_and_metadata
+check_number_type_and_value = prims.check_number_type_and_value
+check_string_value = prims.check_string_value
+check_none = prims.check_none
+check_len = prims.check_len
+device_put = prims.device_put
+unpack_sequence = prims.unpack_sequence
+unpack_key = prims.unpack_key
+
+
+# One broadcast-rule implementation for the whole stack (core/utils.py is
+# what maybe_broadcast already consults).
+compute_broadcast_shape = utils.compute_broadcast_shape
+
+
+@clangop()
+def sigmoid(a):
+    # 1 / (1 + exp(-x)) — the simple composition; XLA fuses it to its
+    # logistic lowering, which handles the large-|x| tails.
+    return true_divide(1.0, add(exp(neg(a)), 1.0))
+
+
+@clangop()
+def silu(a):
+    return mul(a, sigmoid(a))
+
+
+@clangop()
+def diagonal(a, offset: int = 0, dim1: int = 0, dim2: int = 1):
+    """Torch-semantics diagonal: move (dim1, dim2) last, gather the diagonal
+    along the joint index (the canonical decomposition; ltorch delegates
+    here)."""
+    from thunder_tpu.core import dtypes as _dt
+    from thunder_tpu.core.baseutils import check as _check
+
+    d1 = utils.canonicalize_dim(a.ndim, int(pyval(dim1)))
+    d2 = utils.canonicalize_dim(a.ndim, int(pyval(dim2)))
+    _check(d1 != d2, "diagonal dims must differ")
+    k = int(pyval(offset))
+    n, m = a.shape[d1], a.shape[d2]
+    length = max(0, min(n, m - k) if k >= 0 else min(n + k, m))
+    x = movedim(a, (d1, d2), (a.ndim - 2, a.ndim - 1))
+    rows = arange(0, length, 1, device=a.device, dtype=_dt.int64)
+    if k >= 0:
+        ridx, cidx = rows, add(rows, k)
+    else:
+        ridx, cidx = add(rows, -k), rows
+    x = prims.take(x, ridx, x.ndim - 2)
+    cidx_full = expand_to(
+        reshape(cidx, (1,) * (x.ndim - 2) + (length, 1)), tuple(x.shape[:-1]) + (1,)
+    )
+    return squeeze(take_along_axis(x, cidx_full, x.ndim - 1), (x.ndim - 1,))
+
+
+def _index_to_scatter_idx(a, d: int, index, source):
+    """(n,) index vector → scatter_add-shaped index matching ``source``."""
+    return expand_to(
+        reshape(index, (1,) * d + (index.shape[0],) + (1,) * (a.ndim - d - 1)),
+        tuple(source.shape),
+    )
+
+
+@clangop()
+def index_add(a, dim: int, index, source, alpha=1):
+    """The canonical index_add decomposition (ltorch delegates here)."""
+    d = utils.canonicalize_dim(a.ndim, int(pyval(dim)))
+    if pyval(alpha) != 1:
+        source = mul(source, alpha)
+    return scatter_add(a, d, _index_to_scatter_idx(a, d, index, source), source)
+
+
+@clangop()
+def index_copy(a, dim: int, index, source):
+    """scatter-set = scatter_add of (source - current values at index)."""
+    d = utils.canonicalize_dim(a.ndim, int(pyval(dim)))
+    idx = _index_to_scatter_idx(a, d, index, source)
+    current = gather(a, d, idx)
+    return scatter_add(a, d, idx, sub(source, current))
+
+
+@clangop()
+def erfcinv(a):
+    """Inverse complementary error function: erfinv(1 - a)."""
+    return erfinv(sub(1.0, a))
+
+
+@clangop()
+def ndtri(a):
+    """Inverse standard-normal CDF: -sqrt(2)·erfinv(1 - 2a) (scipy.special
+    ndtri semantics, the reference's clang op)."""
+    return mul(erfinv(sub(mul(a, 2.0), 1.0)), 1.4142135623730951)
+
+
+@clangop()
+def uniform_like(a, minval=0.0, maxval=1.0, *, device=None, dtype=None):
+    return uniform(tuple(a.shape), minval, maxval,
+                   device=device or a.device, dtype=dtype or a.dtype)

@@ -675,9 +675,7 @@ clamp_max = _register_elementwise("clamp_max", lambda a, m: clang.clamp(a, None,
 
 @torchsymbol("torch.sigmoid", "torch.nn.functional.sigmoid", method_name="sigmoid")
 def sigmoid(a):
-    # 1 / (1 + exp(-x)) — stable via where on sign, but XLA's logistic is
-    # what this lowers to after fusion; keep the simple composition.
-    return clang.true_divide(1.0, clang.add(1.0, clang.exp(clang.neg(a))))
+    return clang.sigmoid(a)
 
 
 @torchsymbol("torch.nn.functional.softplus")
@@ -1478,49 +1476,17 @@ def diag(a, diagonal: int = 0):
 
 @torchsymbol("torch.diagonal", method_name="diagonal", id="torch.diagonal")
 def diagonal_sym(a, offset: int = 0, dim1: int = 0, dim2: int = 1):
-    d1 = canonicalize_dim(a.ndim, int(pyval(dim1)))
-    d2 = canonicalize_dim(a.ndim, int(pyval(dim2)))
-    check(d1 != d2, "diagonal dims must differ")
-    k = int(pyval(offset))
-    n, m = a.shape[d1], a.shape[d2]
-    length = builtins_max(0, builtins_min(n, m - k) if k >= 0 else builtins_min(n + k, m))
-    # Move (d1, d2) to the end, then gather the diagonal along the last dim.
-    x = clang.movedim(a, (d1, d2), (a.ndim - 2, a.ndim - 1))
-    rows = clang.arange(0, length, 1, device=a.device, dtype=dtypes.int64)
-    if k >= 0:
-        ridx, cidx = rows, clang.add(rows, k)
-    else:
-        ridx, cidx = clang.add(rows, -k), rows
-    x = prims.take(x, ridx, x.ndim - 2)
-    # x: (..., length, m); pick per-row column cidx.
-    cidx_full = clang.expand_to(
-        clang.reshape(cidx, (1,) * (x.ndim - 2) + (length, 1)), tuple(x.shape[:-1]) + (1,)
-    )
-    return clang.squeeze(clang.take_along_axis(x, cidx_full, x.ndim - 1), (x.ndim - 1,))
+    return clang.diagonal(a, offset, dim1, dim2)
 
 
 @torchsymbol("torch.index_add", method_name="index_add")
 def index_add(a, dim: int, index, source, *, alpha=1):
-    d = canonicalize_dim(a.ndim, int(pyval(dim)))
-    if pyval(alpha) != 1:
-        source = clang.mul(source, alpha)
-    idx = clang.expand_to(
-        clang.reshape(index, (1,) * d + (index.shape[0],) + (1,) * (a.ndim - d - 1)),
-        tuple(source.shape),
-    )
-    return clang.scatter_add(a, d, idx, source)
+    return clang.index_add(a, dim, index, source, alpha)
 
 
 @torchsymbol("torch.index_copy", method_name="index_copy")
 def index_copy(a, dim: int, index, source):
-    d = canonicalize_dim(a.ndim, int(pyval(dim)))
-    idx = clang.expand_to(
-        clang.reshape(index, (1,) * d + (index.shape[0],) + (1,) * (a.ndim - d - 1)),
-        tuple(source.shape),
-    )
-    # scatter-set = scatter_add of (src - current values at idx)
-    current = clang.gather(a, d, idx)
-    return clang.scatter_add(a, d, idx, clang.sub(source, current))
+    return clang.index_copy(a, dim, index, source)
 
 
 @torchsymbol("torch.hstack")
