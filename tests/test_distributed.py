@@ -185,6 +185,17 @@ class TestMultiDevice:
         _run_scenario("no_sync_fsdp")
 
 
+class TestExpertAndPipelineParallel:
+    """Beyond-reference: the reference has neither MoE/EP nor PP
+    (SURVEY §2.3)."""
+
+    def test_moe_ep(self):
+        _run_scenario("moe_ep")
+
+    def test_pipeline_pp(self):
+        _run_scenario("pipeline_pp")
+
+
 class TestSequenceParallel:
     """Long-context parallelism — ring + Ulysses attention over the sp axis
     (an extension beyond the reference, which has none: SURVEY.md §5)."""

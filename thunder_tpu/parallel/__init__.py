@@ -19,3 +19,5 @@ from thunder_tpu.parallel.sharding import (  # noqa: F401
     shard_pytree,
 )
 from thunder_tpu.parallel.train import adamw_init, adamw_update, build_train_step  # noqa: F401
+from thunder_tpu.parallel.moe import moe_mlp, moe_mlp_dense_reference  # noqa: F401
+from thunder_tpu.parallel.pipeline import pipeline_apply  # noqa: F401

@@ -1,10 +1,12 @@
 """Device meshes for SPMD execution.
 
 Axes follow the scaling-book convention: ``dp`` (pure data parallel,
-typically over DCN between slices), ``fsdp`` (data parallel with sharded
-params/grads/optimizer — ZeRO — over ICI), ``tp`` (tensor/model parallel over
-ICI), ``sp`` (sequence/context parallel). A mesh only has the axes you give
-it; every sharding helper treats absent axes as size-1.
+typically over DCN between slices), ``pp`` (pipeline stages — slowest links,
+point-to-point only), ``fsdp`` (data parallel with sharded params/grads/
+optimizer — ZeRO — over ICI), ``ep`` (expert parallel, all-to-all heavy),
+``tp`` (tensor/model parallel over ICI), ``sp`` (sequence/context parallel).
+A mesh only has the axes you give it; every sharding helper treats absent
+axes as size-1.
 
 Reference parity: takes the seat of torch.distributed process groups
 (reference: thunder/distributed/__init__.py:193,348 init_process_group) —
@@ -20,30 +22,33 @@ from typing import Optional, Sequence
 import numpy as np
 
 
-AXIS_ORDER = ("dp", "fsdp", "sp", "tp")
+AXIS_ORDER = ("dp", "pp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclass(frozen=True)
 class MeshConfig:
     dp: int = 1
+    pp: int = 1
     fsdp: int = 1
+    ep: int = 1
     sp: int = 1
     tp: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.fsdp * self.sp * self.tp
+        return self.dp * self.pp * self.fsdp * self.ep * self.sp * self.tp
 
     def axis_sizes(self) -> dict[str, int]:
-        return {"dp": self.dp, "fsdp": self.fsdp, "sp": self.sp, "tp": self.tp}
+        return {"dp": self.dp, "pp": self.pp, "fsdp": self.fsdp,
+                "ep": self.ep, "sp": self.sp, "tp": self.tp}
 
 
 def make_mesh(config: MeshConfig | dict | None = None, *, devices: Optional[Sequence] = None, **axes):
     """Build a `jax.sharding.Mesh` with the given axis sizes.
 
-    Axis order is fixed (dp, fsdp, sp, tp) — outer axes change slowest, so
-    dp lands across DCN and tp across adjacent ICI neighbours, matching how
-    `jax.devices()` orders a slice.
+    Axis order is fixed (dp, pp, fsdp, ep, sp, tp) — outer axes change
+    slowest, so dp/pp land across DCN / slice boundaries and tp across
+    adjacent ICI neighbours, matching how `jax.devices()` orders a slice.
     """
     import jax
     from jax.sharding import Mesh

@@ -1,0 +1,118 @@
+"""Mixture-of-Experts with expert parallelism over an ``ep`` mesh axis.
+
+The reference has **no** MoE or expert parallelism (SURVEY.md §2.3: EP
+absent) — this is first-class here because the TPU ICI all-to-all makes the
+canonical dispatch pattern natural:
+
+- Experts are sharded over ``ep``: each device owns ``E / ep`` experts'
+  weights (the expert-parallel memory win).
+- Tokens are routed top-k by a learned router, packed into per-expert
+  capacity slots via one-hot dispatch einsums (dense, MXU-friendly — no
+  data-dependent shapes, the XLA-compatible form of token dropping), sent
+  to the owning devices with ONE ``all_to_all``, transformed by the local
+  experts as a batched einsum, and returned with the reverse ``all_to_all``;
+  the combine einsum applies the router weights.
+- Written in pure differentiable jax (all_to_all has a transpose rule), so
+  ``jax.grad`` through the routed computation — including the router —
+  works; run inside ``shard_map`` over the ``ep`` axis.
+
+With ``capacity_factor`` high enough that no token is dropped, the result
+is exactly the dense computation ``Σ_k p_k · expert_{i_k}(x)`` — the
+8-device CPU-mesh test asserts that equivalence and gradient parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def moe_mlp(
+    x,
+    router_w,
+    w1,
+    w2,
+    axis_name: str,
+    *,
+    top_k: int = 2,
+    capacity: Optional[int] = None,
+    activation=None,
+):
+    """Expert-parallel MoE MLP for one device's token shard.
+
+    Args (local, inside shard_map over ``axis_name``):
+      x: (n, d) local tokens.
+      router_w: (d, E) router weights, replicated. E = total experts.
+      w1: (E_local, d, h) this device's expert up-projections.
+      w2: (E_local, h, d) this device's expert down-projections.
+      top_k: experts per token.
+      capacity: per-(source device, expert) slot count C. Default n
+        (no token ever dropped — exact dense equivalence); production
+        configs use ~ top_k·n/E · capacity_factor.
+
+    Returns (n, d) combined expert outputs (router-weighted).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n, d = x.shape
+    e_local = w1.shape[0]
+    ep = lax.psum(1, axis_name)
+    E = e_local * ep
+    C = int(capacity) if capacity is not None else n
+    act = activation if activation is not None else jax.nn.gelu
+
+    xf = x.astype(jnp.float32)
+    logits = xf @ router_w.astype(jnp.float32)  # (n, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_i = lax.top_k(probs, top_k)  # (n, k)
+
+    # Dense dispatch bookkeeping (Shazeer-style): slot position of each
+    # (token, choice) within its expert's capacity, dropped when over C.
+    choice_mask = jax.nn.one_hot(top_i, E, dtype=jnp.float32)  # (n, k, E)
+    flat_mask = choice_mask.reshape(n * top_k, E)
+    pos = jnp.cumsum(flat_mask, axis=0) - flat_mask  # slot index per (n·k, E)
+    pos = (pos * flat_mask).reshape(n, top_k, E)
+    keep = (pos < C).astype(jnp.float32) * choice_mask
+    slot_oh = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)  # (n,k,E,C)
+    dispatch = (slot_oh * keep[..., None]).sum(axis=1)  # (n, E, C) ∈ {0,1}
+    combine = (slot_oh * (keep * top_p[..., None])[..., None]).sum(axis=1)  # (n, E, C)
+
+    # Pack and ship: device m's sent[g·e_local + l] holds its tokens bound
+    # for device g's local expert l. Tiled all_to_all splits dim 0 into ep
+    # groups and concatenates what each device receives along dim 1:
+    # recv[l, m·C + c] = device m's capacity slot c for my local expert l.
+    sent = jnp.einsum("nd,nec->ecd", xf, dispatch)  # (E, C, d)
+    recv = lax.all_to_all(sent, axis_name, split_axis=0, concat_axis=1, tiled=True)
+    # recv: (e_local, ep·C, d)
+
+    # Local experts as one batched einsum pair (MXU).
+    h = act(jnp.einsum("ecd,edh->ech", recv, w1.astype(jnp.float32)))
+    y = jnp.einsum("ech,ehd->ecd", h, w2.astype(jnp.float32))  # (e_local, ep·C, d)
+
+    # Return trip (the exact transpose shuffle) + combine.
+    back = lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0, tiled=True)
+    # back: (E, C, d) — back[g·e_local + l, c] = global expert g·e_local+l's
+    # output for my capacity slot c.
+    out = jnp.einsum("ecd,nec->nd", back, combine)
+    return out.astype(x.dtype)
+
+
+def moe_mlp_dense_reference(x, router_w, w1_full, w2_full, *, top_k: int = 2, activation=None):
+    """Oracle: per-token dense Σ_k p_k · expert_{i_k}(x) with the FULL
+    (unsharded) expert weights. Exactly what moe_mlp computes when no token
+    is dropped."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    act = activation if activation is not None else jax.nn.gelu
+    xf = x.astype(jnp.float32)
+    probs = jax.nn.softmax(xf @ router_w.astype(jnp.float32), axis=-1)
+    top_p, top_i = lax.top_k(probs, top_k)
+
+    # Compute every expert on every token (dense), then select.
+    h = act(jnp.einsum("nd,edh->neh", xf, w1_full.astype(jnp.float32)))
+    all_out = jnp.einsum("neh,ehd->ned", h, w2_full.astype(jnp.float32))  # (n, E, d)
+    sel = jnp.take_along_axis(all_out, top_i[..., None], axis=1)  # (n, k, d)
+    return (sel * top_p[..., None]).sum(axis=1).astype(x.dtype)

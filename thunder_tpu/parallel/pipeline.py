@@ -1,0 +1,69 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a ``pp`` axis.
+
+The reference has **no** pipeline parallelism (SURVEY.md §2.3: PP absent) —
+here it is a ~60-line differentiable schedule because the TPU mapping is
+natural: stages live on consecutive devices along the ``pp`` mesh axis,
+activations hop stage→stage with ``ppermute`` (nearest-neighbour ICI), and
+the whole schedule is one ``lax.scan`` — a single compiled program, no
+per-microbatch host dispatch.
+
+Semantics: ``n_micro`` microbatches flow through ``n_stages`` stages in
+``n_micro + n_stages − 1`` ticks (the classic GPipe fill/steady/drain
+schedule). Every op used (scan, ppermute, dynamic slicing, where-masking)
+has a transpose rule, so ``jax.grad`` through ``pipeline_apply`` IS
+pipeline-parallel backprop — the backward replays the schedule in reverse
+with cotangents hopping the ring the other way.
+"""
+
+from __future__ import annotations
+
+
+def pipeline_apply(stage_fn, local_params, xs, axis_name: str):
+    """Run ``stage_fn`` as a pipeline over the ``axis_name`` mesh axis.
+
+    Inside ``shard_map``:
+      stage_fn: (params, x) -> y with x/y of identical shape (stage i
+        consumes stage i−1's output).
+      local_params: THIS stage's parameter pytree (stack the per-stage
+        params outside and shard dim 0 over ``pp``; squeeze before passing).
+      xs: (n_micro, mb, ...) the full microbatch stream, replicated — only
+        stage 0 reads it.
+
+    Returns (n_micro, mb, ...) outputs, replicated across the axis (zeros
+    from non-final stages are psum-combined with the final stage's buffer).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = xs.shape[0]
+    ticks = n_micro + n_stages - 1
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    act0 = jnp.zeros(xs.shape[1:], xs.dtype)
+    outs0 = jnp.zeros_like(xs)
+
+    def tick(carry, t):
+        act, outs = carry
+        # Activations hop one stage down the ring.
+        recv = lax.ppermute(act, axis_name, perm)
+        # Stage 0 feeds the next microbatch during the fill/steady phase.
+        feed = jnp.where(
+            t < n_micro,
+            lax.dynamic_index_in_dim(xs, jnp.minimum(t, n_micro - 1), 0, keepdims=False),
+            jnp.zeros_like(act0),
+        )
+        x_in = jnp.where(stage == 0, feed, recv)
+        y = stage_fn(local_params, x_in)
+        # The final stage emits microbatch t − (n_stages − 1) once the
+        # pipe is full; earlier ticks and other stages write nothing.
+        j = t - (n_stages - 1)
+        updated = lax.dynamic_update_index_in_dim(outs, y, jnp.maximum(j, 0), 0)
+        emit = jnp.logical_and(stage == n_stages - 1, j >= 0)
+        outs = jnp.where(emit, updated, outs)
+        return (y, outs), None
+
+    (_, outs), _ = lax.scan(tick, (act0, outs0), jnp.arange(ticks))
+    # Replicate the final stage's buffer to every device (others hold zeros).
+    return lax.psum(outs, axis_name)
