@@ -480,7 +480,7 @@ def _topk_samples(dt):
     yield SampleInput(make_tensor((4, 6), dt, seed=115), 3, 1)
 
 
-_add(OpInfo("topk", ltorch.topk, torch.topk, _topk_samples, dtypes=FLOATS32, supports_grad=False))
+_add(OpInfo("topk", ltorch.topk, torch.topk, _topk_samples, dtypes=FLOATS32))
 _add(OpInfo("sort", ltorch.sort, torch.sort,
             lambda dt: iter([SampleInput(make_tensor((4, 6), dt, seed=116), 1),
                              SampleInput(make_tensor((4, 6), dt, seed=117), 0, True)]),

@@ -37,6 +37,8 @@ def _sum_outputs(out):
 
 
 def _torch_sum_outputs(out):
+    if isinstance(out, tuple) and type(out) is not tuple:
+        out = tuple(out)  # torch.return_types.* structseq → plain tuple
     flat, _ = tree_flatten(out)
     total = None
     for o in flat:

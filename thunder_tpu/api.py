@@ -526,25 +526,38 @@ def value_and_grad(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
 # =============================================================================
 
 
-def _staged_flat_fn(fn: Callable, args: tuple):
-    """Trace+claim fn for the given example args → (flat jax callable,
-    flat example args)."""
+def _staged_flat_fn(fn: Callable, args: tuple, kwargs: Optional[dict] = None,
+                    executors: Optional[Sequence] = None) -> Callable:
+    """Trace+claim fn for the given example args → flat jax callable whose
+    inputs are the TENSOR leaves of (args, kwargs) in pytree order (number/
+    string leaves are prologue-guarded constants baked into the trace)."""
     from thunder_tpu.executors.passes import transform_for_execution
 
-    _, comp = trace_program(fn, args, {})
+    _, comp = trace_program(fn, args, kwargs or {})
     comp = dce(comp)
-    extrace = transform_for_execution(comp, resolve_executors(["jax"]))
-    flat_args, _ = tree_flatten((args, {}))
-    return extrace.python_callable(), flat_args
+    extrace = transform_for_execution(comp, resolve_executors(executors))
+    return extrace.python_callable()
+
+
+# Exceptions that signal "this kernel claim cannot run under the requested
+# jax transform" (missing batching rule → NotImplementedError; custom_vjp
+# under jvp → TypeError) — anything else propagates from the first attempt.
+_KERNEL_TRANSFORM_ERRORS = (NotImplementedError, TypeError)
 
 
 def vmap(fn: Callable, in_axes=0, out_axes=0) -> Callable:
-    """Vectorizing map over the traced program (experimental)."""
+    """Vectorizing map over the traced program (experimental; reference
+    transforms.py `vmap:2051` is experimental too).
+
+    Traces ``fn`` on one slice with the FULL executor list (kernel claims
+    included), then batches the staged callable under ``jax.vmap``; if a
+    claimed kernel has no batching rule, the call transparently re-stages
+    with the jax executor only. kwargs are passed through unbatched."""
     import jax
 
     def vmapped(*args, **kwargs):
-        check(not kwargs, "vmap kwargs are not supported", NotImplementedError)
-        # Trace on one slice; batch the staged function.
+        # Trace on one slice; batch the staged function. Per-arg in_axes
+        # apply to every tensor leaf of that arg (pytree args included).
         def slice_ax(x, ax):
             if ax is None or not hasattr(x, "shape"):
                 return x
@@ -553,26 +566,52 @@ def vmap(fn: Callable, in_axes=0, out_axes=0) -> Callable:
             return np.asarray(x).take(0, axis=ax)
 
         axes = in_axes if isinstance(in_axes, (tuple, list)) else (in_axes,) * len(args)
-        example = tuple(slice_ax(a, ax) for a, ax in zip(args, axes))
-        flat_fn, _ = _staged_flat_fn(fn, example)
+        example = tuple(
+            tree_map(lambda x, _ax=ax: slice_ax(x, _ax), a) for a, ax in zip(args, axes)
+        )
+        # The staged computation's inputs are the TENSOR leaves only (number/
+        # string leaves are prologue-guarded constants baked into the trace).
         flat_axes = []
+        flat_args = []
         for a, ax in zip(args, axes):
-            flat_a, _ = tree_flatten(a)
-            flat_axes.extend([ax if bridge.is_concrete_tensor(x) else None for x in flat_a])
-        flat_args = [bridge.to_jax(x) for x in tree_flatten((args, {}))[0]]
-        return jax.jit(jax.vmap(flat_fn, in_axes=flat_axes, out_axes=out_axes))(*flat_args)
+            for x in tree_flatten(a)[0]:
+                if bridge.is_concrete_tensor(x):
+                    flat_axes.append(ax)
+                    flat_args.append(bridge.to_jax(x))
+        for x in tree_flatten(kwargs)[0]:
+            if bridge.is_concrete_tensor(x):
+                flat_axes.append(None)
+                flat_args.append(bridge.to_jax(x))
+        for ex_list in (None, ["jax"]):
+            flat_fn = _staged_flat_fn(fn, example, kwargs, executors=ex_list)
+            try:
+                return jax.jit(jax.vmap(flat_fn, in_axes=flat_axes, out_axes=out_axes))(*flat_args)
+            except _KERNEL_TRANSFORM_ERRORS:
+                if ex_list is not None:
+                    raise
+                # A claimed kernel without a batching rule: fall back to the
+                # pure-jax claiming and let XLA batch the decomposition.
 
     return vmapped
 
 
 def jvp(fn: Callable, primals: tuple, tangents: tuple):
-    """Forward-mode derivative of the traced program (experimental)."""
+    """Forward-mode derivative of the traced program (experimental;
+    reference `jvp:2324`). Kernel claims are attempted first; custom-VJP
+    kernels (no JVP rule) transparently re-stage with the jax executor."""
     import jax
 
-    flat_fn, _ = _staged_flat_fn(fn, tuple(primals))
-    flat_p = [bridge.to_jax(x) for x in tree_flatten((tuple(primals), {}))[0]]
-    flat_t = [bridge.to_jax(x) for x in tree_flatten((tuple(tangents), {}))[0]]
-    return jax.jvp(flat_fn, tuple(flat_p), tuple(flat_t))
+    flat_p = [bridge.to_jax(x) for x in tree_flatten((tuple(primals), {}))[0]
+              if bridge.is_concrete_tensor(x)]
+    flat_t = [bridge.to_jax(x) for x in tree_flatten((tuple(tangents), {}))[0]
+              if bridge.is_concrete_tensor(x)]
+    for ex_list in (None, ["jax"]):
+        flat_fn = _staged_flat_fn(fn, tuple(primals), executors=ex_list)
+        try:
+            return jax.jvp(flat_fn, tuple(flat_p), tuple(flat_t))
+        except _KERNEL_TRANSFORM_ERRORS:
+            if ex_list is not None:
+                raise
 
 
 # =============================================================================

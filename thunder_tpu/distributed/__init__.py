@@ -34,9 +34,11 @@ class FSDPType(enum.Enum):
 
 
 class FSDPBucketingStrategy(enum.Enum):
-    """Reference parity: `FSDPBucketingStrategy:261`. On TPU, bucketing is
-    XLA's collective-combiner's job; accepted for API compatibility and used
-    as a hint for the combiner threshold flag."""
+    """Reference parity: `FSDPBucketingStrategy:261`. Accepted for API
+    compatibility; it deliberately has no effect here — collective
+    coalescing is XLA's combiner pass (the `sort_waits`/bucketing seat,
+    SURVEY §5), tunable globally via
+    `--xla_tpu_*_combine_threshold_bytes` XLA flags rather than per-call."""
 
     NONE = enum.auto()
     LAYER = enum.auto()

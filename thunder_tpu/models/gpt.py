@@ -49,9 +49,12 @@ class GPTConfig:
     bias: bool = True
     norm_class: str = "LayerNorm"  # or "RMSNorm"
     norm_eps: float = 1e-5
-    mlp_class: str = "GptNeoxMLP"  # or "LLaMAMLP"
+    mlp_class: str = "GptNeoxMLP"  # or "LLaMAMLP" / "MoEMLP"
     intermediate_size: Optional[int] = None
     rope_base: int = 10000
+    # MoE (mlp_class="MoEMLP", mixtral-style SwiGLU experts):
+    n_expert: int = 0
+    n_expert_per_token: int = 2
 
     @property
     def head_size(self) -> int:
@@ -114,6 +117,16 @@ _add(GPTConfig(name="open_llama_3b", block_size=2048, vocab_size=32000, padded_v
                n_layer=26, n_head=32, n_embd=3200, rotary_percentage=1.0, parallel_residual=False,
                bias=False, norm_class="RMSNorm", norm_eps=1e-6, mlp_class="LLaMAMLP",
                intermediate_size=8640))
+
+# Mixtral-style MoE family (beyond-reference: SURVEY §2.3 has no EP/MoE).
+_add(GPTConfig(name="mixtral-tiny", block_size=64, vocab_size=96, padded_vocab_size=96,
+               n_layer=2, n_head=4, n_embd=32, n_query_groups=2, rotary_percentage=1.0,
+               parallel_residual=False, bias=False, norm_class="RMSNorm",
+               mlp_class="MoEMLP", intermediate_size=64, n_expert=4, n_expert_per_token=2))
+_add(GPTConfig(name="mixtral-8x7b", block_size=4096, vocab_size=32000, padded_vocab_size=32000,
+               n_layer=32, n_head=32, n_embd=4096, n_query_groups=8, rotary_percentage=1.0,
+               parallel_residual=False, bias=False, norm_class="RMSNorm", norm_eps=1e-5,
+               mlp_class="MoEMLP", intermediate_size=14336, n_expert=8, n_expert_per_token=2))
 
 # Mistral — reference benchmark ladder step 5 (GQA).
 _add(GPTConfig(name="mistral-7b", block_size=4096, vocab_size=32000, padded_vocab_size=32000,
@@ -184,7 +197,13 @@ def init_params(config: GPTConfig, *, dtype=dtypes.bfloat16, seed: int = 0, devi
         if C.bias:
             p["attn"]["qkv_b"] = zeros(C.qkv_out)
             p["attn"]["proj_b"] = zeros(C.n_embd)
-        if C.mlp_class == "LLaMAMLP":
+        if C.mlp_class == "MoEMLP":
+            E, H = C.n_expert, C.mlp_hidden
+            p["mlp"]["router_w"] = w(E, C.n_embd)
+            p["mlp"]["w1"] = w(E, H, C.n_embd)
+            p["mlp"]["w3"] = w(E, H, C.n_embd)
+            p["mlp"]["w2"] = w(E, C.n_embd, H, std=0.02 / np.sqrt(2 * C.n_layer))
+        elif C.mlp_class == "LLaMAMLP":
             p["mlp"]["fc_1_w"] = w(C.mlp_hidden, C.n_embd)
             p["mlp"]["fc_2_w"] = w(C.mlp_hidden, C.n_embd)
             p["mlp"]["proj_w"] = w(C.n_embd, C.mlp_hidden, std=0.02 / np.sqrt(2 * C.n_layer))
@@ -269,7 +288,31 @@ def _attention(x, p, cos, sin, config: GPTConfig):
     return ttorch.linear(y, p["proj_w"], p.get("proj_b"))
 
 
+def _moe_mlp(x, p, config: GPTConfig):
+    """Mixtral-style MoE: top-k softmax routing over SwiGLU experts,
+    renormalized gate weights. Dense per-token formulation at the trace
+    level (every expert computed, top-k selected) — static shapes the MXU
+    tiles; the distributed execution path with real token dispatch over an
+    ``ep`` mesh axis is thunder_tpu.parallel.moe.moe_mlp."""
+    B, T, C = x.shape
+    k = config.n_expert_per_token
+    xf = ttorch.reshape(x, (B * T, C))
+    gate_logits = ttorch.linear(xf, p["router_w"])            # (N, E)
+    top_logits, top_i = ttorch.topk(gate_logits, k, -1)       # (N, k)
+    gate = ttorch.softmax(top_logits, -1)                     # renormalized over the k chosen
+    h = ttorch.silu(ttorch.einsum("nd,ehd->neh", xf, p["w1"])) * ttorch.einsum(
+        "nd,ehd->neh", xf, p["w3"]
+    )
+    all_out = ttorch.einsum("neh,edh->ned", h, p["w2"])       # (N, E, C)
+    idx3 = ttorch.expand(ttorch.unsqueeze(top_i, -1), (B * T, k, C))
+    sel = ttorch.take_along_dim(all_out, idx3, 1)             # (N, k, C)
+    out = ttorch.sum(sel * ttorch.unsqueeze(gate, -1), 1)
+    return ttorch.reshape(out, (B, T, C))
+
+
 def _mlp(x, p, config: GPTConfig):
+    if config.mlp_class == "MoEMLP":
+        return _moe_mlp(x, p, config)
     if config.mlp_class == "LLaMAMLP":
         h = ttorch.silu(ttorch.linear(x, p["fc_1_w"], p.get("fc_1_b"))) * ttorch.linear(
             x, p["fc_2_w"], p.get("fc_2_b")

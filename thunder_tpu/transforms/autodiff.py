@@ -465,6 +465,18 @@ def _gather_vjp(bsym, g):
     return (_scatter_back(a, idx, g, dim), None, None)
 
 
+@register_vjp(PrimIDs.TOPK)
+def _topk_vjp(bsym, gv, gi=None):
+    # (values, indices) outputs; indices are non-differentiable. The values
+    # cotangent scatters back to the selected positions (MoE routers etc.).
+    a, k, dim = bsym.args[0], bsym.args[1], bsym.args[2]
+    if not _is_float_tensor(a) or gv is None:
+        return (None, None, None, None, None)
+    idx = bsym.output[1]
+    z = clang.full(tuple(a.shape), 0, device=a.device, dtype=a.dtype)
+    return (prims.scatter_add(z, idx, gv, dim), None, None, None, None)
+
+
 @register_vjp(PrimIDs.SCATTER_ADD)
 def _scatter_add_vjp(bsym, g):
     # Prim signature is (a, indices, value, dim) — grads must align.
