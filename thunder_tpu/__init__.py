@@ -39,3 +39,18 @@ from thunder_tpu.common import (  # noqa: F401
     ThunderSharpEdgeWarning,
 )
 
+# Legacy entry point (reference parity: thunder.compile, thunder/__init__.py:655
+# — deprecated there in favor of jit; same here). Excluded from __all__ so
+# `from thunder_tpu import *` cannot shadow the Python builtin.
+compile = jit
+
+__all__ = [
+    "jit", "grad", "value_and_grad", "vmap", "jvp", "seed",
+    "compile_data", "compile_stats", "last_traces", "last_prologue_traces",
+    "last_backward_traces", "last_compile_options", "cache_hits",
+    "cache_misses", "set_execution_callback_file",
+    "CACHE_OPTIONS", "SHARP_EDGES_OPTIONS",
+    "ThunderSharpEdgeError", "ThunderSharpEdgeWarning",
+    "dtypes", "devices",
+]
+

@@ -1,0 +1,110 @@
+"""Distributed benchmark runner: sweep mesh configurations, one subprocess
+per config.
+
+Reference parity: thunder/benchmarks/distributed.py (`run_multiprocess_benchmark
+:605` — spawns one process per rank over NCCL and aggregates). On TPU a mesh
+is driven by a single controller, so "multiprocess per rank" becomes one
+subprocess per *mesh configuration* (clean jax runtime each), either on the
+real device set or on a virtual CPU mesh (``--virtual N``) — the same
+no-hardware story the tests use.
+
+Usage:
+    python -m thunder_tpu.benchmarks.distributed --model pythia-160m \
+        --configs dp8,fsdp8,fsdp4-tp2,dp2-fsdp2-tp2 --virtual 8 --iters 5
+
+Each config line prints the litgpt CLI's JSON summary (tokens/sec,
+TFLOP/s → MFU, memory, iteration time) tagged with the mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+
+def parse_config(spec: str) -> dict:
+    """'dp2-fsdp2-tp2' → {'dp': 2, 'fsdp': 2, 'tp': 2}."""
+    import re
+
+    axes: dict[str, int] = {}
+    for part in spec.split("-"):
+        m = re.fullmatch(r"(dp|pp|fsdp|ep|sp|tp)(\d+)", part)
+        if not m:
+            raise ValueError(f"Bad mesh spec {spec!r} (part {part!r})")
+        if m.group(1) in axes:
+            raise ValueError(f"Duplicate axis {m.group(1)!r} in mesh spec {spec!r}")
+        axes[m.group(1)] = int(m.group(2))
+    return axes
+
+
+def run_config(spec: str, *, model: str, micro_batch: int, seq: int, iters: int,
+               virtual: int = 0) -> dict:
+    try:
+        axes = parse_config(spec)
+    except ValueError as e:
+        return {"mesh": spec, "error": str(e)}
+    cmd = [
+        sys.executable, "-m", "thunder_tpu.benchmarks.litgpt",
+        "--model", model, "--micro-batch", str(micro_batch), "--seq", str(seq),
+        "--iters", str(iters),
+    ]
+    for ax, n in axes.items():
+        if ax in ("dp", "fsdp", "tp"):
+            cmd += [f"--{ax}", str(n)]
+        else:
+            return {"mesh": spec, "error": f"axis {ax} not exposed by the litgpt CLI"}
+
+    env = dict(os.environ)
+    if virtual:
+        # Clean CPU-mesh runtime: drop any site package that pins the real
+        # accelerator and force N virtual devices.
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env = {
+            "PATH": env.get("PATH", "/usr/bin:/bin"),
+            "HOME": env.get("HOME", "/root"),
+            "PYTHONPATH": repo,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": f"--xla_force_host_platform_device_count={virtual}",
+        }
+
+    try:
+        r = subprocess.run(cmd, env=env, capture_output=True, text=True, timeout=1800)
+    except subprocess.TimeoutExpired:
+        return {"mesh": spec, "error": "timed out after 1800 s"}
+    if r.returncode != 0:
+        return {"mesh": spec, "error": r.stderr[-500:]}
+    try:
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"mesh": spec, "error": f"unparseable output: {r.stdout[-300:]}"}
+    out["mesh"] = spec
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="pythia-160m")
+    p.add_argument("--micro-batch", type=int, default=4)
+    p.add_argument("--seq", type=int, default=512)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--configs", default="dp8,fsdp8,fsdp4-tp2")
+    p.add_argument("--virtual", type=int, default=0,
+                   help="run each config on an N-virtual-CPU-device mesh")
+    args = p.parse_args()
+
+    for spec in args.configs.split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        summary = run_config(
+            spec, model=args.model, micro_batch=args.micro_batch,
+            seq=args.seq, iters=args.iters, virtual=args.virtual,
+        )
+        print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()
