@@ -168,3 +168,92 @@ class TestRoundTrip:
         b = np.random.randn(4, 5).astype(np.float32)
         out = fn(a, b)
         np.testing.assert_allclose(np.asarray(out), (a + b) * (a + b), rtol=1e-5)
+
+
+class TestTransformEdges:
+    """Pass edge cases (reference: test_core.py's transform coverage)."""
+
+    def test_cse_preserves_random_ops(self):
+        """Two identical uniform() calls must NOT merge — RNG ops are
+        value-distinct even with identical arguments."""
+        import thunder_tpu.clang as clang
+        from thunder_tpu.api import trace_program
+        from thunder_tpu.transforms.common import cse, dce
+
+        def f(a):
+            u1 = clang.uniform((4,), 0.0, 1.0, device=a.device, dtype=a.dtype)
+            u2 = clang.uniform((4,), 0.0, 1.0, device=a.device, dtype=a.dtype)
+            return clang.add(clang.add(u1, u2), a)
+
+        x = np.random.randn(4).astype(np.float32)
+        _, comp = trace_program(f, (x,), {})
+        before = comp.python().count("uniform")
+        after = cse(dce(comp)).python().count("uniform")
+        assert before == after == 2
+
+    def test_cse_merges_through_swapped_operands_not(self):
+        """a+b and b+a have different RHS keys (no algebraic rewriting) but
+        a+b twice merges."""
+        import thunder_tpu.clang as clang
+        from thunder_tpu.api import trace_program
+        from thunder_tpu.transforms.common import cse, dce
+
+        def f(a, b):
+            return clang.mul(clang.add(a, b), clang.add(a, b))
+
+        x = np.random.randn(3).astype(np.float32)
+        y = np.random.randn(3).astype(np.float32)
+        _, comp = trace_program(f, (x, y), {})
+        merged = cse(dce(comp))
+        assert merged.python().count("add") == 1
+
+        def g(a, b):
+            return clang.mul(clang.add(a, b), clang.add(b, a))
+
+        _, comp2 = trace_program(g, (x, y), {})
+        merged2 = cse(dce(comp2))
+        assert merged2.python().count("add") == 2  # no commutative rewriting
+
+    def test_dce_keeps_outputs_and_inputs_signature(self):
+        import thunder_tpu.clang as clang
+        from thunder_tpu.api import trace_program
+        from thunder_tpu.transforms.common import dce
+
+        def f(a, b):
+            dead = clang.mul(a, 100.0)  # noqa: F841 — dead on purpose
+            return clang.add(a, b)
+
+        x = np.random.randn(3).astype(np.float32)
+        _, comp = trace_program(f, (x, x), {})
+        out = dce(comp)
+        assert "100.0" not in out.python()
+        # Args keep the full signature even when some are unused post-DCE.
+        assert len(out.args) == len(comp.args)
+
+    def test_provenance_chain_across_passes(self):
+        import thunder_tpu
+        import thunder_tpu.torch as ttorch
+
+        jf = thunder_tpu.jit(lambda a: ttorch.sum(ttorch.tanh(a) * 2.0))
+        jf(np.random.randn(3, 3).astype(np.float32))
+        traces = thunder_tpu.last_traces(jf)
+        assert len(traces) >= 3  # raw → dce → cse → ... → claimed
+        provs = [str(t.provenance) for t in traces if t.provenance is not None]
+        assert any("Dead Code Elimination" in p for p in provs)
+        assert any("Common Subexpression Elimination" in p for p in provs)
+
+    def test_from_bsym_swap_proxies_rewrites_args(self):
+        import thunder_tpu.clang as clang
+        from thunder_tpu.api import trace_program
+        from thunder_tpu.core.proxies import variableify
+
+        def f(a, b):
+            return clang.add(a, b)
+
+        x = np.random.randn(3).astype(np.float32)
+        _, comp = trace_program(f, (x, x), {})
+        add_bsym = next(b for b in comp.bound_symbols if b.sym.name == "add")
+        a0, b0 = comp.args
+        swapped = add_bsym.from_bsym_swap_proxies({variableify(a0): b0}, skip_output=True)
+        names = [p.name for p in swapped.flat_proxy_args]
+        assert names == [b0.name, b0.name]

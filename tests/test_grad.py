@@ -89,7 +89,7 @@ def test_grad(opinfo, executor, dtype):
         assert len(grads) == len(want), (
             f"{opinfo.name}: grad arity {len(grads)} != {len(want)}"
         )
-        tol = tolerances(dtype, opinfo)
+        tol = tolerances(dtype, opinfo, executor)
         tol = dict(rtol=max(tol["rtol"], 1e-4), atol=max(tol["atol"], 1e-4))
         for g, w in zip(grads, want):
             if w is None:

@@ -30,7 +30,7 @@ def test_forward(opinfo, executor, dtype):
         assert_close(
             _flat(got), _flat(want),
             err=f"{opinfo.name} sample {i} ({sample})",
-            **tolerances(dtype, opinfo),
+            **tolerances(dtype, opinfo, executor),
         )
 
 

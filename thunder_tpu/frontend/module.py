@@ -187,7 +187,18 @@ class _swapped_params:
 
 
 class ThunderModule:
-    """Compiled wrapper around a torch.nn.Module (reference: __init__.py:178)."""
+    """Compiled wrapper around a torch.nn.Module (reference: __init__.py:178).
+
+    Caching design: compiled entries are keyed on the input metadata tuple
+    (shape/device/dtype/requires_grad per leaf + pytree spec + the no_sync
+    flag) instead of re-executing generated prologue guards as the
+    functional frontend does. For a module the guarded surface is exactly
+    that metadata — the parameters are owned by the module and version-
+    tracked separately (`_refresh_stale_params`), so a dict probe checks the
+    same facts a prologue re-run would, in O(inputs) without Python-frame
+    overhead per guard. Introspection parity is kept by recording the same
+    CompileData/CompileStats the functional path uses (`last_traces`,
+    `cache_hits` etc. work on jitted modules)."""
 
     def __init__(self, module, **jit_options):
         from thunder_tpu.common import CompileData, CompileStats
