@@ -93,7 +93,6 @@ def build_train(cfg_name: str, batch: int, seq: int):
     trace_s = time.perf_counter() - t0
 
     flat_params, _ = tree_flatten((params,))
-    n_p = len(flat_params)
 
     def step(flat_p, i, t):
         loss, saved = fw_fn(*flat_p, i, t)
