@@ -842,6 +842,70 @@ _add(OpInfo("arange", ltorch.arange, torch.arange,
             dtypes=FLOATS32, supports_grad=False))
 
 
+# =============================================================================
+# Coverage completion: remaining deterministic torchsymbols (3D conv/pool,
+# rms_norm, views, creation) — every implemented op family has a matrix row.
+# =============================================================================
+
+nn_opinfo("conv3d", ltorch.conv3d, F.conv3d,
+          lambda dt: iter([SampleInput(make_tensor((1, 2, 4, 6, 6), dt, seed=310),
+                                       make_tensor((3, 2, 3, 3, 3), dt, seed=311),
+                                       make_tensor((3,), dt, seed=312), 1, 1)]),
+          dtypes=FLOATS32)
+nn_opinfo("max_pool3d", ltorch.max_pool3d, F.max_pool3d,
+          lambda dt: iter([SampleInput(make_tensor((1, 2, 4, 4, 4), dt, seed=313), 2)]),
+          dtypes=FLOATS32)
+nn_opinfo("avg_pool3d", ltorch.avg_pool3d, F.avg_pool3d,
+          lambda dt: iter([SampleInput(make_tensor((1, 2, 4, 4, 4), dt, seed=314), 2)]),
+          dtypes=FLOATS32)
+nn_opinfo("adaptive_avg_pool1d", ltorch.adaptive_avg_pool1d, F.adaptive_avg_pool1d,
+          lambda dt: iter([SampleInput(make_tensor((2, 3, 8), dt, seed=315), 2),
+                           SampleInput(make_tensor((2, 3, 8), dt, seed=316), 1)]),
+          dtypes=FLOATS32)
+nn_opinfo("rms_norm", ltorch.rms_norm, F.rms_norm,
+          lambda dt: iter([SampleInput(make_tensor((4, 6), dt, seed=317), (6,),
+                                       make_tensor((6,), dt, seed=318)),
+                           SampleInput(make_tensor((2, 3, 6), dt, seed=319), (6,))]))
+
+_add(OpInfo("vdot", ltorch.vdot, torch.vdot,
+            lambda dt: iter([SampleInput(make_tensor((6,), dt, seed=320), make_tensor((6,), dt, seed=321))]),
+            dtypes=FLOATS32))
+_add(OpInfo("t", ltorch.t, torch.t,
+            lambda dt: iter([SampleInput(make_tensor((3, 4), dt, seed=322)),
+                             SampleInput(make_tensor((5,), dt, seed=323))]),
+            dtypes=FLOATS32 + INTS))
+_add(OpInfo("clone", ltorch.clone, torch.clone,
+            lambda dt: iter([SampleInput(make_tensor((3, 4), dt, seed=324))]),
+            dtypes=FLOATS32 + INTS))
+_add(OpInfo("view", ltorch.view, torch.Tensor.view,
+            lambda dt: iter([SampleInput(make_tensor((2, 6), dt, seed=325), (3, 4)),
+                             SampleInput(make_tensor((2, 6), dt, seed=326), (-1,))]),
+            dtypes=FLOATS32 + INTS))
+_add(OpInfo("to", lambda a: ltorch.to(a, torch.float32), lambda a: a.to(torch.float32),
+            lambda dt: iter([SampleInput(make_tensor((3, 4), dt, seed=327))]),
+            dtypes=(torch.bfloat16, torch.int64), supports_grad=False))
+_add(OpInfo("type_as", ltorch.type_as, torch.Tensor.type_as,
+            lambda dt: iter([SampleInput(make_tensor((3, 4), torch.int64, seed=328),
+                                         make_tensor((2,), dt, seed=329))]),
+            dtypes=FLOATS32, supports_grad=False))
+
+
+def _index_put_samples(dt):
+    yield SampleInput(make_tensor((5, 3), dt, seed=330), (torch.tensor([0, 2, 4]),),
+                      make_tensor((3, 3), dt, seed=331), False)
+    yield SampleInput(make_tensor((5, 3), dt, seed=332), (torch.tensor([1, 1]),),
+                      make_tensor((2, 3), dt, seed=333), True)
+
+
+_add(OpInfo("index_put", ltorch.index_put, torch.index_put, _index_put_samples,
+            dtypes=FLOATS32, supports_grad=False))
+
+_add(OpInfo("ones", lambda: ltorch.ones(3, 4), lambda: torch.ones(3, 4),
+            lambda dt: iter([SampleInput()]), dtypes=FLOATS32, supports_grad=False))
+_add(OpInfo("zeros", lambda: ltorch.zeros(2, 5), lambda: torch.zeros(2, 5),
+            lambda dt: iter([SampleInput()]), dtypes=FLOATS32, supports_grad=False))
+_add(OpInfo("full", lambda: ltorch.full((3, 2), 7.0), lambda: torch.full((3, 2), 7.0),
+            lambda dt: iter([SampleInput()]), dtypes=FLOATS32, supports_grad=False))
 # Transcendental-lowered composites whose defs span complex nesting above:
 # attach the shared loose-f32 override post-hoc (see TRANS_F32).
 _TRANS_OPS = {
@@ -849,6 +913,7 @@ _TRANS_OPS = {
     "conv2d", "interpolate_bilinear", "interpolate_nearest", "layer_norm",
     "instance_norm", "normalize", "logsumexp", "huber_loss", "smooth_l1_loss",
     "norm", "var", "std", "var_mean", "std_mean", "mean", "prod",
+    "conv3d", "rms_norm",
 }
 for _op in opinfos:
     if _op.name in _TRANS_OPS and torch.float32 not in _op.tol_overrides:

@@ -413,3 +413,61 @@ class TestCustomAutogradFunction:
         m_ref(x).backward()
         torch.testing.assert_close(m_jit.lin.weight.grad, m_ref.lin.weight.grad,
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestConvNet:
+    """A ResNet-style CNN through the module frontend: conv2d + BatchNorm
+    (running-stats epilogue) + ReLU + max-pool + adaptive-avg-pool + linear,
+    forward parity, training parity, and eval-mode stats usage."""
+
+    class SmallResNet(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, 8, 3, padding=1, bias=False)
+            self.bn1 = nn.BatchNorm2d(8)
+            self.conv2 = nn.Conv2d(8, 8, 3, padding=1, bias=False)
+            self.bn2 = nn.BatchNorm2d(8)
+            self.fc = nn.Linear(8, 5)
+
+        def forward(self, x):
+            h = F.relu(self.bn1(self.conv1(x)))
+            h = F.max_pool2d(h, 2)
+            h = F.relu(self.bn2(self.conv2(h)) + h)  # residual
+            h = F.adaptive_avg_pool2d(h, 1).flatten(1)
+            return self.fc(h)
+
+    def test_train_parity_and_running_stats(self):
+        torch.manual_seed(0)
+        m_ref = self.SmallResNet()
+        m_jit = self.SmallResNet()
+        m_jit.load_state_dict(m_ref.state_dict())
+        tm = thunder_tpu.jit(m_jit)
+
+        x = torch.randn(4, 3, 8, 8)
+        t = torch.randint(0, 5, (4,))
+
+        opt_ref = torch.optim.SGD(m_ref.parameters(), lr=0.05)
+        opt_jit = torch.optim.SGD(m_jit.parameters(), lr=0.05)
+        for _ in range(3):
+            opt_jit.zero_grad()
+            loss_j = F.cross_entropy(tm(x), t)
+            loss_j.backward()
+            opt_jit.step()
+
+            opt_ref.zero_grad()
+            loss_r = F.cross_entropy(m_ref(x), t)
+            loss_r.backward()
+            opt_ref.step()
+            torch.testing.assert_close(loss_j, loss_r, rtol=2e-3, atol=1e-4)
+
+        # BatchNorm running stats advanced identically (the epilogue path).
+        torch.testing.assert_close(m_jit.bn1.running_mean, m_ref.bn1.running_mean,
+                                   rtol=2e-3, atol=1e-4)
+        torch.testing.assert_close(m_jit.bn1.running_var, m_ref.bn1.running_var,
+                                   rtol=2e-3, atol=1e-4)
+
+        # Eval mode consumes the stats (not batch statistics).
+        tm.eval()
+        m_ref.eval()
+        with torch.no_grad():
+            torch.testing.assert_close(tm(x), m_ref(x), rtol=2e-3, atol=1e-4)
