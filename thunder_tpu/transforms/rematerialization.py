@@ -194,10 +194,15 @@ def rematerialize_forward_and_backward(
     if not recompute:
         return fw_trace, bw_trace
 
-    # New saved set: kept names + all recompute frontiers not already available.
+    # New saved set: kept names + all recompute frontiers not already
+    # available. Frontiers are sets — iterate them SORTED so the saved-tuple
+    # order (and therefore the staged program's HLO, and therefore the
+    # persistent-compile-cache key) is identical across processes; unsorted
+    # iteration varies with the per-process hash seed and made every fresh
+    # run a cache miss.
     new_saved: list[str] = list(keep)
     for name, (chain, frontier) in recompute.items():
-        for f in frontier:
+        for f in sorted(frontier):
             if f not in new_saved and f not in arg_proxies:
                 new_saved.append(f)
     # Frontier values that are fw *args* must still be passed to bw.
