@@ -105,21 +105,77 @@ def build_train(cfg_name: str, batch: int, seq: int):
         ]
         return new_p, loss
 
-    jfn = jax.jit(step, donate_argnums=(0,))
-    return jfn, flat_params, idx, tgt, init_s, trace_s
+    t0 = time.perf_counter()
+    jfn, flat_params = _stage_step(step, flat_params, idx, tgt)
+    stage_s = time.perf_counter() - t0
+    return jfn, flat_params, idx, tgt, init_s, trace_s, stage_s
+
+
+def _stage_step(step, flat_params, idx, tgt):
+    """Stage the train step with compiler-chosen (AUTO) parameter layouts.
+
+    With default row-major arg layouts XLA re-lays-out the weight matrices
+    EVERY iteration (~25-45 ms/step of pure copies at 3B scale — measured in
+    the r4 profile: 45.7 ms/iter 'data formatting', dominated by
+    bf16[9600,3200]-style param copies). AUTO layouts let the compiler pick
+    the layouts it wants, and the params are device_put into them once,
+    outside the timed loop. Opt out with THUNDER_BENCH_AUTOLAYOUT=0.
+    """
+    import os
+
+    import jax
+
+    if os.environ.get("THUNDER_BENCH_AUTOLAYOUT", "1") == "0":
+        return jax.jit(step, donate_argnums=(0,)), flat_params
+    try:
+        from jax.experimental.layout import Format, Layout
+
+        auto = Format(Layout.AUTO)
+        jitted = jax.jit(
+            step,
+            donate_argnums=(0,),
+            in_shardings=([auto] * len(flat_params), auto, auto),
+            out_shardings=([auto] * len(flat_params), auto),
+        )
+        compiled = jitted.lower(flat_params, idx, tgt).compile()
+        in_fmts = compiled.input_formats[0]
+        out_fmts = compiled.output_formats
+        # The loop feeds outputs back as inputs: layouts must round-trip.
+        assert str(out_fmts[0]) == str(in_fmts[0]), "param layouts don't round-trip"
+        flat_params = [jax.device_put(p, f) for p, f in zip(flat_params, in_fmts[0])]
+        return compiled, flat_params
+    except Exception as e:
+        print(f"# autolayout staging failed ({type(e).__name__}: {e}); "
+              "falling back to default layouts", file=sys.stderr)
+        return jax.jit(step, donate_argnums=(0,)), flat_params
 
 
 def _bench_forward():
+    import os
+
     import jax
 
     flat_fn, flat_args, init_s, trace_s = build_forward("open_llama_3b", FWD_B, FWD_T)
-    jfn = jax.jit(flat_fn)
+    t0 = time.perf_counter()
+    if os.environ.get("THUNDER_BENCH_AUTOLAYOUT", "1") == "0":
+        jfn = jax.jit(flat_fn)
+    else:
+        try:
+            from jax.experimental.layout import Format, Layout
+
+            auto = Format(Layout.AUTO)
+            jitted = jax.jit(flat_fn, in_shardings=tuple(auto for _ in flat_args))
+            compiled = jitted.lower(*flat_args).compile()
+            flat_args = [jax.device_put(a, f) for a, f in zip(flat_args, compiled.input_formats[0])]
+            jfn = compiled
+        except Exception as e:
+            print(f"# fwd autolayout failed ({type(e).__name__}); default layouts", file=sys.stderr)
+            jfn = jax.jit(flat_fn)
 
     def run():
         out = jfn(*flat_args)
         return float(np.asarray(out[0, 0, 0]))
 
-    t0 = time.perf_counter()
     run()
     compile_s = time.perf_counter() - t0
     # Async-dispatch 5 forwards, sync once: amortizes the axon tunnel's
@@ -136,32 +192,45 @@ def _bench_forward():
 
 
 def _bench_train():
-    jfn, flat_params, idx, tgt, init_s, trace_s = build_train("open_llama_3b", TRAIN_B, TRAIN_T)
+    jfn, flat_params, idx, tgt, init_s, trace_s, stage_s = build_train("open_llama_3b", TRAIN_B, TRAIN_T)
 
     t0 = time.perf_counter()
     flat_params, loss = jfn(flat_params, idx, tgt)
     loss0 = float(np.asarray(loss))
-    compile_s = time.perf_counter() - t0
+    compile_s = stage_s + time.perf_counter() - t0
 
-    # Reference protocol: 45 timed iters after warmup (train.py:60-67),
-    # measured as total wall for the whole run. Iterations are chained
-    # through the donated params and dispatched asynchronously — syncing the
-    # host every iteration would add the axon tunnel's ~95 ms round-trip per
-    # step (measured), which is launch overhead, not training throughput
-    # (training loops don't read the loss back every step either).
+    # Two timing protocols, both reported (ADVICE r3: the A100 baseline
+    # constant comes from the reference's train.py, whose timed region syncs
+    # on loss.item() every iteration):
+    #  - async: 45 iters chained through the donated params, ONE final sync.
+    #    Amortizes the axon tunnel's ~95 ms host round-trip (an environment
+    #    artifact of the tunnel, not device throughput — a local host syncs
+    #    in microseconds).
+    #  - synced: per-iteration block_until_ready on the loss, the reference's
+    #    protocol verbatim. On this tunnel it pays the full round-trip per
+    #    step, so it UNDERSTATES device throughput; reported for honesty as
+    #    train_iter_synced_s.
     t0 = time.perf_counter()
     for _ in range(45):
         flat_params, loss = jfn(flat_params, idx, tgt)
     loss_last = float(np.asarray(loss))  # one sync at the end
     total = time.perf_counter() - t0
     avg = total / 45.0
+
+    t0 = time.perf_counter()
+    n_sync = 10
+    for _ in range(n_sync):
+        flat_params, loss = jfn(flat_params, idx, tgt)
+        loss.block_until_ready()
+    synced_avg = (time.perf_counter() - t0) / n_sync
     print(
         f"# train param-init: {init_s:.1f}s trace+claim: {trace_s:.1f}s compile: {compile_s:.1f}s "
-        f"45 iters: {total:.2f}s avg iter: {avg:.4f}s loss {loss0:.3f}->{loss_last:.3f}",
+        f"45 iters: {total:.2f}s avg iter: {avg:.4f}s (synced {synced_avg:.4f}s) "
+        f"loss {loss0:.3f}->{loss_last:.3f}",
         file=sys.stderr,
     )
     assert np.isfinite(loss_last) and loss_last < loss0, (loss0, loss_last)
-    return avg, total, trace_s, compile_s
+    return avg, synced_avg, total, trace_s, compile_s
 
 
 def _tpu_peak_tflops() -> float:
@@ -184,7 +253,7 @@ def main() -> None:
 
     _ensure_runtime()  # torch-faithful dtypes + persistent XLA compile cache
     fwd_avg, fwd_trace_s, fwd_compile_s = _bench_forward()
-    train_avg, train_total, train_trace_s, train_compile_s = _bench_train()
+    train_avg, train_synced, train_total, train_trace_s, train_compile_s = _bench_train()
 
     peak = _tpu_peak_tflops()
     fwd_flops = 2.0 * N_PARAMS * FWD_B * FWD_T
@@ -205,6 +274,14 @@ def main() -> None:
         "train_45iters_s": round(train_total, 2),
         "train_tokens_per_sec": round(TRAIN_B * TRAIN_T / train_avg),
         "train_mfu": round(train_mfu, 3),
+        # Protocol disclosure (ADVICE r3): headline numbers use async
+        # dispatch with one final sync; the reference's A100 constant was
+        # measured with a per-iter loss sync. The synced figure below pays
+        # the axon tunnel's ~95 ms/step host round-trip and bounds the
+        # comparison from the other side.
+        "timing_protocol": "async_45iter_chain_single_sync",
+        "ref_timing_protocol": "per_iter_loss_sync (reference train.py)",
+        "train_iter_synced_s": round(train_synced, 4),
         "fwd_b10_s": round(fwd_avg, 4),
         "fwd_vs_baseline": round(REF_FWD_A100_S / fwd_avg, 3),
         "fwd_mfu": round(fwd_mfu, 3),

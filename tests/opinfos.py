@@ -918,3 +918,100 @@ _TRANS_OPS = {
 for _op in opinfos:
     if _op.name in _TRANS_OPS and torch.float32 not in _op.tol_overrides:
         _op.tol_overrides = {**TRANS_F32, **_op.tol_overrides}
+
+
+# =============================================================================
+# Error inputs (reference: thunder/tests/opinfos.py:328 `error_input_generator`
+# / :396 `error_inputs` — invalid calls must raise a clear exception at trace
+# time; the message is a product surface for a compiler)
+# =============================================================================
+
+
+class ErrorInput:
+    """One invalid call: args/kwargs + the expected exception and a stable
+    fragment of its message."""
+
+    def __init__(self, sample: SampleInput, ex_type=Exception, regex: str = ""):
+        self.sample = sample
+        self.ex_type = ex_type
+        self.regex = regex
+
+    def __repr__(self):
+        return f"ErrorInput({self.sample}, {getattr(self.ex_type, '__name__', self.ex_type)}, {self.regex!r})"
+
+
+def _T(*shape, dtype=torch.float32, **kw):
+    return make_tensor(shape, dtype, **kw)
+
+
+def _error_table() -> dict:
+    E = ErrorInput
+    S = SampleInput
+    t45 = _T(4, 5)
+    ti = _T(4, dtype=torch.int64, low=0, high=3)
+    return {
+        # shape ops
+        "reshape": [E(S(t45, (3, 3)), Exception, "reshape")],
+        "view": [E(S(t45, (7, 2)), Exception, "reshape|view")],
+        "permute": [E(S(t45, (0,)), Exception, "permut")],
+        "transpose": [E(S(t45, 0, 5), Exception, "[Dd]im")],
+        "squeeze": [E(S(t45, 7), Exception, "[Dd]im")],
+        "unsqueeze": [E(S(t45, 9), Exception, "[Dd]im")],
+        "expand": [E(S(t45, (4, 4)), Exception, "[Ee]xpand|broadcast")],
+        "cat": [
+            E(S([t45, _T(3, 4)], 0), Exception, "(cat|size|shape|dim)"),
+            E(S([], 0), Exception, "(cat|empty|at least)"),
+        ],
+        "stack": [E(S([t45, _T(5, 4)], 0), Exception, "(stack|size|shape)")],
+        "split": [E(S(t45, 3, 2), Exception, "[Dd]im")],
+        "chunk": [E(S(t45, 0), Exception, "(chunk|positive|> 0)")],
+        "flip": [E(S(t45, (4,)), Exception, "[Dd]im")],
+        "flatten": [E(S(t45, 3, 1), Exception, "[Dd]im")],
+        "movedim": [E(S(t45, 0, 6), Exception, "[Dd]im")],
+        # matmul family
+        "matmul": [E(S(t45, _T(4, 5)), Exception, "(matmul|contract|inner|size|shape)")],
+        "mm": [E(S(t45, _T(4, 5)), Exception, "(mm|size|shape|contraction)")],
+        "bmm": [E(S(t45, t45), Exception, "(bmm|rank|3)")],
+        "mv": [E(S(t45, _T(3)), Exception, "(mv|size|shape|contraction)")],
+        "dot": [E(S(_T(4), _T(5)), Exception, "(dot|size|shape|length|contraction)")],
+        "linear": [E(S(t45, _T(6, 7)), Exception, "(linear|size|shape|inner|contract)")],
+        "outer": [E(S(t45, _T(3)), Exception, "(outer|1-?[Dd]|rank|vector)")],
+        # reductions / softmax
+        "softmax": [E(S(t45, 5), Exception, "[Dd]im")],
+        "log_softmax": [E(S(t45, -4), Exception, "[Dd]im")],
+        "sum": [E(S(t45, 3), Exception, "[Dd]im")],
+        "amax": [E(S(t45, 4), Exception, "[Dd]im")],
+        "mean": [E(S(t45, 2), Exception, "[Dd]im")],
+        "topk": [E(S(t45, 9, 1), Exception, "(topk|k|size)")],
+        "cumsum": [E(S(t45, 5), Exception, "[Dd]im")],
+        # indexing / embedding / losses
+        "embedding": [E(S(ti, _T(8)), Exception, "rank")],
+        "gather": [E(S(t45, 4, ti.reshape(4, 1)), Exception, "[Dd]im")],
+        "index_select": [E(S(t45, 3, ti), Exception, "[Dd]im")],
+        "cross_entropy": [
+            E(S(_T(4, 8), make_tensor((5,), torch.int64, low=0, high=8)), Exception,
+              "(cross_entropy|batch|size|shape)"),
+        ],
+        "nll_loss": [
+            E(S(_T(4, 8).log_softmax(1), make_tensor((5,), torch.int64, low=0, high=8)),
+              Exception, "(nll|batch|size|shape)"),
+        ],
+        # norms / attention
+        "layer_norm": [E(S(t45, (7,), _T(7), _T(7)), Exception, "(normalized|shape|size)")],
+        "rms_norm": [E(S(t45, (9,), _T(9)), Exception, "(normalized|shape|size)")],
+        "scaled_dot_product_attention": [
+            E(S(_T(2, 2, 8, 4), _T(2, 2, 8, 4), _T(2, 2, 8, 4),
+                is_causal=True, attn_mask=_T(8, 8)), Exception, "(causal|mutually exclusive|mask)"),
+        ],
+        "glu": [E(S(t45, 1), Exception, "(glu|even|halve|divisible)")],
+        "tril": [E(S(_T(5)), Exception, "(rank|2)")],
+        "one_hot": [E(S(ti, -1), Exception, "(num_classes|classes)")],
+        "masked_fill": [E(S(t45, _T(3, 3, dtype=torch.bool), 0.0), Exception, "(broadcast|shape|size)")],
+    }
+
+
+_ERRORS = _error_table()
+for _op in opinfos:
+    _errs = _ERRORS.get(_op.name) if _op.error_generator is None else None
+    if _errs:
+        _op.error_generator = (lambda _e: (lambda: iter(_e)))(_errs)

@@ -58,3 +58,25 @@ def test_error_inputs():
         thunder_tpu.jit(lambda a: ltorch.squeeze(a, 7))(x)  # bad dim
     with pytest.raises(Exception):
         thunder_tpu.jit(lambda a: ltorch.one_hot(a.long(), -1))(x)  # needs num_classes
+
+
+# Generated error-input matrix (reference: thunder/tests/opinfos.py:328,396
+# + the matching test_ops checks): every populated error generator's invalid
+# call must raise the expected exception type with the expected fragment.
+def test_error_inputs_generated():
+    import re
+
+    import pytest
+
+    import thunder_tpu
+
+    checked = 0
+    for opinfo in opinfos:
+        if opinfo.error_generator is None:
+            continue
+        for ei in opinfo.error_generator():
+            with pytest.raises(ei.ex_type, match=ei.regex) if ei.regex else pytest.raises(ei.ex_type):
+                thunder_tpu.jit(opinfo.op)(*ei.sample.args, **ei.sample.kwargs)
+            checked += 1
+    # the table covers the ~30 highest-traffic ops; keep it honest
+    assert checked >= 30, f"only {checked} error inputs ran"

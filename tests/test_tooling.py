@@ -108,6 +108,63 @@ class TestCheckpoint:
         for x, y in zip(a, b):
             np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
+    def test_async_save(self, tmp_path):
+        from thunder_tpu.core import dtypes
+        from thunder_tpu.distributed.checkpoint import load, save
+        from thunder_tpu.models import gpt as m
+
+        cfg = m.name_to_config("gpt-tiny")
+        params = m.init_params(cfg, dtype=dtypes.float32, seed=4)
+        path = str(tmp_path / "ckpt_async")
+        handle = save(params, path, async_save=True)
+        assert handle is not None
+        handle.wait()
+        restored = load(path)
+        from thunder_tpu.core.pytree import tree_flatten
+
+        for x, y in zip(tree_flatten(params)[0], tree_flatten(restored)[0]):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_rank0_full_state_dict_export(self, tmp_path):
+        """Consolidated single-file export (reference StateDictOptions
+        rank0_only + full_state_dict, checkpoint.py:35)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from thunder_tpu.distributed.checkpoint import StateDictOptions, load, save
+
+        devs = np.array(jax.devices("cpu")[:8])
+        mesh = Mesh(devs, ("fsdp",))
+        w = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+        sharded = {"w": jax.device_put(w, NamedSharding(mesh, P("fsdp", None)))}
+        path = str(tmp_path / "ckpt_full")
+        save(sharded, path, options=StateDictOptions(full_state_dict=True, rank0_only=True))
+        restored = load(path)
+        np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(w))
+
+    def test_reshard_roundtrip_different_mesh(self, tmp_path):
+        """Save on an fsdp-8 mesh, restore onto an fsdp-4 mesh (reference:
+        load:197 reshards via DTensor; Orbax + shard_pytree must too)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from thunder_tpu.distributed.checkpoint import load, save
+
+        cpu = jax.devices("cpu")
+        mesh8 = Mesh(np.array(cpu[:8]), ("fsdp",))
+        mesh4 = Mesh(np.array(cpu[:4]), ("fsdp",))
+        w = jnp.arange(16 * 8, dtype=jnp.float32).reshape(16, 8)
+        state = {"w": jax.device_put(w, NamedSharding(mesh8, P("fsdp", None)))}
+        path = str(tmp_path / "ckpt_reshard")
+        save(state, path)
+        restored = load(path, mesh=mesh4, specs={"w": P("fsdp", None)})
+        arr = restored["w"]
+        assert arr.sharding.mesh.shape["fsdp"] == 4
+        assert arr.sharding.spec == P("fsdp", None)
+        np.testing.assert_array_equal(np.asarray(arr), np.asarray(w))
+
 
 class TestTraceDump:
     def test_execution_callback_file(self, tmp_path):
@@ -162,3 +219,52 @@ class TestCompileStats:
 
         tm(torch.randn(5, 8))  # new shape → miss
         assert cs.cache_misses == 2
+
+
+class TestExamineFullReport:
+    """examine() enumerates ALL unsupported ops in one pass and separates
+    user exceptions from coverage gaps (reference: examine/__init__.py:17-49
+    TorchFunctionMode collector)."""
+
+    def test_lists_all_unsupported(self):
+        torch = pytest.importorskip("torch")
+        import torch.nn as nn
+
+        from thunder_tpu.examine import examine
+
+        class Bad(nn.Module):
+            def forward(self, x):
+                a = torch.special.i0(x)
+                b = torch.linalg.svd(x)[0]
+                c = torch.fft.fft(x).real
+                return a + b + c
+
+        r = examine(Bad(), torch.randn(4, 4))
+        assert not r["supported"]
+        joined = " ".join(r["unsupported_ops"])
+        assert "special_i0" in joined and "linalg_svd" in joined and "fft_fft" in joined
+        assert len(r["unsupported_ops"]) >= 3
+
+    def test_user_error_separated(self):
+        torch = pytest.importorskip("torch")
+        import torch.nn as nn
+
+        from thunder_tpu.examine import examine
+
+        class Buggy(nn.Module):
+            def forward(self, x):
+                raise ValueError("user bug")
+
+        r = examine(Buggy(), torch.randn(2))
+        assert "user bug" in r.get("user_error", "")
+        assert r["unsupported_ops"] == []
+
+    def test_supported_module_passes(self):
+        torch = pytest.importorskip("torch")
+        import torch.nn as nn
+
+        from thunder_tpu.examine import examine
+
+        m = nn.Sequential(nn.Linear(8, 8), nn.GELU())
+        r = examine(m, torch.randn(2, 8))
+        assert r["supported"] and r["unsupported_ops"] == []

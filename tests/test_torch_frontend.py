@@ -471,3 +471,66 @@ class TestConvNet:
         m_ref.eval()
         with torch.no_grad():
             torch.testing.assert_close(tm(x), m_ref(x), rtol=2e-3, atol=1e-4)
+
+
+class TestMaskedHuggingFace:
+    """HF models WITH an attention_mask — the padded-batch workload the mask-
+    capable flash executor exists for (reference bar: cudnnex.py:81-92), and
+    the value-guard machinery (core/concrete.py) that lets HF's
+    ``padding_mask.all()`` branch trace and cache correctly."""
+
+    def _llama(self):
+        transformers = pytest.importorskip("transformers")
+        cfg = transformers.LlamaConfig(
+            vocab_size=64, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, intermediate_size=88, max_position_embeddings=256,
+            tie_word_embeddings=False, attn_implementation="sdpa",
+        )
+        torch.manual_seed(0)
+        return transformers.LlamaForCausalLM(cfg).eval()
+
+    def test_llama_padded_mask_claims_flash(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_FLASH_FORCE", "1")
+        m = self._llama().to(torch.bfloat16)
+        tm = thunder_tpu.jit(m)
+        idx = torch.from_numpy(np.random.RandomState(1).randint(0, 64, (2, 128)))
+        am = torch.ones(2, 128, dtype=torch.long)
+        am[0, :40] = 0  # left padding on row 0
+        got = tm(idx, attention_mask=am)["logits"].float()
+        src = thunder_tpu.last_traces(tm)[-1].python()
+        assert "flash_scaled_dot_product_attention" in src
+        with torch.no_grad():
+            want = m(idx, attention_mask=am).logits.float()
+        g, w = got.detach().numpy(), want.numpy()
+        # pad-query rows are undefined under the flash kernel; valid rows match
+        np.testing.assert_allclose(g[0, 40:], w[0, 40:], rtol=5e-2, atol=5e-2)
+        np.testing.assert_allclose(g[1], w[1], rtol=5e-2, atol=5e-2)
+
+    def test_mask_value_guard_controls_cache(self):
+        m = self._llama()
+        tm = thunder_tpu.jit(m)
+        cs = tm._lc_cs
+        idx = torch.from_numpy(np.random.RandomState(1).randint(0, 64, (2, 128)))
+        padded = torch.ones(2, 128, dtype=torch.long)
+        padded[0, :40] = 0
+        ones = torch.ones(2, 128, dtype=torch.long)
+
+        got_p = tm(idx, attention_mask=padded)["logits"]
+        assert cs.cache_misses == 1
+        tm(idx, attention_mask=padded)
+        assert (cs.cache_misses, cs.cache_hits) == (1, 1)
+        # same metadata, different mask CONTENT → HF takes the no-mask branch;
+        # the value guard must force a controlled retrace, not reuse
+        got_1 = tm(idx, attention_mask=ones)["logits"]
+        assert cs.cache_misses == 2
+        # both specializations stay live
+        tm(idx, attention_mask=ones)
+        tm(idx, attention_mask=padded)
+        assert cs.cache_misses == 2 and cs.cache_hits == 3
+
+        with torch.no_grad():
+            want_p = m(idx, attention_mask=padded).logits
+            want_1 = m(idx, attention_mask=ones).logits
+        np.testing.assert_allclose(got_1.detach().numpy(), want_1.numpy(), rtol=1e-3, atol=1e-3)
+        valid = got_p.detach().numpy()[0, 40:]
+        np.testing.assert_allclose(valid, want_p.numpy()[0, 40:], rtol=1e-3, atol=1e-3)

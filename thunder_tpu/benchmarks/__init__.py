@@ -84,16 +84,38 @@ class BenchmarkResult:
     pipelined: bool = False
 
     @property
+    def pruned_times_s(self) -> list[float]:
+        """Outlier-pruned samples (reference: benchmarks/__init__.py:220-455
+        prunes timing outliers before reporting): drop points beyond
+        1.5×IQR of the quartiles. With <4 samples nothing is pruned."""
+        ts = sorted(self.times_s)
+        if len(ts) < 4:
+            return ts
+        q1 = float(np.percentile(ts, 25))
+        q3 = float(np.percentile(ts, 75))
+        lo, hi = q1 - 1.5 * (q3 - q1), q3 + 1.5 * (q3 - q1)
+        pruned = [t for t in ts if lo <= t <= hi]
+        return pruned or ts
+
+    @property
+    def outliers(self) -> int:
+        return len(self.times_s) - len(self.pruned_times_s)
+
+    @property
     def median_s(self) -> float:
-        return statistics.median(self.times_s)
+        return statistics.median(self.pruned_times_s)
 
     @property
     def mean_s(self) -> float:
-        return statistics.fmean(self.times_s)
+        return statistics.fmean(self.pruned_times_s)
 
     @property
     def stdev_s(self) -> float:
-        return statistics.stdev(self.times_s) if len(self.times_s) > 1 else 0.0
+        ts = self.pruned_times_s
+        return statistics.stdev(ts) if len(ts) > 1 else 0.0
+
+    def percentile_s(self, q: float) -> float:
+        return float(np.percentile(self.pruned_times_s, q))
 
     @property
     def tokens_per_sec(self) -> Optional[float]:
@@ -119,6 +141,12 @@ class BenchmarkResult:
         else:
             d["median_iter_time_s"] = round(self.median_s, 5)
             d["stdev_s"] = round(self.stdev_s, 6)
+            d["p25_s"] = round(self.percentile_s(25), 5)
+            d["p75_s"] = round(self.percentile_s(75), 5)
+            if self.iters >= 10:
+                d["p90_s"] = round(self.percentile_s(90), 5)
+            if self.outliers:
+                d["outliers_pruned"] = self.outliers
         if self.tokens_per_sec:
             d["tokens_per_sec"] = round(self.tokens_per_sec)
         if self.tflops_per_sec:

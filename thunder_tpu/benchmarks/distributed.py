@@ -84,6 +84,33 @@ def run_config(spec: str, *, model: str, micro_batch: int, seq: int, iters: int,
     return out
 
 
+def weak_scaling(*, model: str, micro_batch: int, seq: int, iters: int,
+                 axis: str = "dp", max_devices: int = 8) -> list[dict]:
+    """Weak-scaling sweep over the virtual mesh: device count doubles while
+    the PER-DEVICE batch stays constant, so ideal scaling is flat iteration
+    time and linear total tokens/sec (reference: distributed.py:605's
+    multi-rank sweeps answer the same question over NCCL). Each point runs
+    in its own subprocess with an N-virtual-CPU-device runtime."""
+    points = []
+    n = 1
+    while n <= max_devices:
+        spec = f"{axis}{n}" if n > 1 else "dp1"
+        out = run_config(
+            spec, model=model, micro_batch=micro_batch * n, seq=seq,
+            iters=iters, virtual=max(n, 1),
+        )
+        out["devices"] = n
+        out["global_batch"] = micro_batch * n
+        base = points[0] if points else out
+        if "tokens_per_sec" in out and "tokens_per_sec" in base:
+            out["scaling_efficiency"] = round(
+                out["tokens_per_sec"] / (base["tokens_per_sec"] * n), 3
+            )
+        points.append(out)
+        n *= 2
+    return points
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="pythia-160m")
@@ -93,7 +120,18 @@ def main() -> None:
     p.add_argument("--configs", default="dp8,fsdp8,fsdp4-tp2")
     p.add_argument("--virtual", type=int, default=0,
                    help="run each config on an N-virtual-CPU-device mesh")
+    p.add_argument("--weak-scaling", default="",
+                   help="axis to weak-scale over the virtual mesh (dp|fsdp): "
+                        "1→N devices, constant per-device batch")
     args = p.parse_args()
+
+    if args.weak_scaling:
+        for point in weak_scaling(
+            model=args.model, micro_batch=args.micro_batch, seq=args.seq,
+            iters=args.iters, axis=args.weak_scaling,
+        ):
+            print(json.dumps(point), flush=True)
+        return
 
     for spec in args.configs.split(","):
         spec = spec.strip()

@@ -608,16 +608,42 @@ def getitem(a, key):
         taken = prims.take(a, flat, 0)
         return reshape(taken, tuple(idx.shape) + tuple(a.shape[1:]))
 
-    # Count specified dims (non-None, non-Ellipsis)
+    # Count specified dims (non-None, non-Ellipsis). Identity checks only:
+    # `in`/`==` on a key containing TensorProxies would trace elementwise eq.
     n_spec = len([k for k in key if k is not None and k is not Ellipsis])
     check(n_spec <= a.ndim, "too many indices")
     # Expand Ellipsis
-    if Ellipsis in key:
-        idx = key.index(Ellipsis)
+    ell = next((i for i, k in enumerate(key) if k is Ellipsis), None)
+    if ell is not None:
         fill = a.ndim - n_spec
-        key = key[:idx] + (slice(None),) * fill + key[idx + 1 :]
+        key = key[:ell] + (slice(None),) * fill + key[ell + 1 :]
     else:
         key = key + (slice(None),) * (a.ndim - n_spec)
+
+    # Multi-tensor advanced indexing over every dim (e.g. HF's
+    # ``padding_mask[batch_idx, kv_idx]`` with broadcasting index tensors):
+    # broadcast the indices together, linearize, and gather from the
+    # flattened array.
+    if len([k for k in key if isinstance(k, TensorProxy)]) >= 2:  # clang.sum shadows builtins.sum
+        check(
+            len(key) == a.ndim
+            and all(isinstance(k, (TensorProxy, int, NumberProxy)) for k in key),
+            lambda: "advanced-indexing subset: multiple tensor indices must cover every dim",
+        )
+        linear = None
+        for k, size in zip(key, a.shape):
+            if isinstance(k, TensorProxy):
+                kk = where(lt(k, 0), add(k, size), k)
+            else:
+                kv = int(pyval(k))
+                kk = kv + size if kv < 0 else kv
+            linear = kk if linear is None else add(mul(linear, size), kk)
+        if isinstance(linear, TensorProxy):
+            out_shape = tuple(linear.shape)
+            flat_idx = reshape(linear, (linear.numel,))
+            taken = prims.take(reshape(a, (a.numel,)), flat_idx, 0)
+            return reshape(taken, out_shape)
+        return getitem(reshape(a, (a.numel,)), linear)
 
     starts, ends, strides = [], [], []
     squeeze_dims = []  # dims indexed by int → removed

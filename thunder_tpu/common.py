@@ -77,10 +77,26 @@ _sharp_edges_policy = contextvars.ContextVar(
 )
 
 
+_sharp_edges_suppressed = contextvars.ContextVar("sharp_edges_suppressed", default=False)
+
+
+@contextlib.contextmanager
+def suppress_sharp_edges():
+    """Scope for framework-internal work during tracing (e.g. guarded
+    concretization) whose own env/clock reads are not USER sharp edges."""
+    tok = _sharp_edges_suppressed.set(True)
+    try:
+        yield
+    finally:
+        _sharp_edges_suppressed.reset(tok)
+
+
 def sharp_edge(msg: str) -> None:
     """Report a tracing-unsafe construct per the active policy. ALLOW is
     silent (the reference's default); WARN emits ThunderSharpEdgeWarning;
     ERROR raises ThunderSharpEdgeError."""
+    if _sharp_edges_suppressed.get():
+        return
     policy = _sharp_edges_policy.get()
     if policy is SHARP_EDGES_OPTIONS.ALLOW:
         return
@@ -143,6 +159,9 @@ class CacheEntry:
     return_none_instead_of_grads: bool = False
     torch_facing: bool = False
     needs_rng: bool = False
+    # Guards over input-derived scalar values that the trace specialized on
+    # (core/concrete.py): all must re-evaluate equal for a cache hit.
+    value_guards: tuple = ()
 
 
 class CompileStats:

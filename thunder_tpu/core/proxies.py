@@ -472,9 +472,32 @@ class TensorProxy(Proxy):
         return self.numel
 
     def __bool__(self):
+        return self._concretize("bool")
+
+    def __int__(self):
+        return self._concretize("int")
+
+    def __float__(self):
+        return self._concretize("float")
+
+    def __index__(self):
+        return self._concretize("int")
+
+    def _concretize(self, kind: str):
+        """Python-scalar coercion of a traced tensor: evaluated eagerly on
+        the trace's concrete example inputs and protected by a cache value
+        guard (core/concrete.py). Reference parity: the interpreter frontend
+        runs such branches on real tensors (jit_ext.py) and constrains the
+        cache via prologue guards."""
+        from thunder_tpu.core.concrete import concretize_scalar
+
+        val = concretize_scalar(self, kind)
+        if val is not None:
+            return val
         raise RuntimeError(
-            "Cannot branch on the value of a traced tensor (data-dependent control flow); "
-            "use lax-style control flow or mark the value static"
+            f"Cannot {kind}() a traced tensor with no concrete value (data-dependent "
+            "control flow in a detached trace); use lax-style control flow or mark "
+            "the value static"
         )
 
     # -- method / operator dispatch via the active language ------------------

@@ -195,12 +195,16 @@ class BoundSymbol(baseutils.BoundSymbolInterface):
                 return Variable(x)
             return baseutils.make_hashable(x) if baseutils.is_collection(x) else x
 
-        flat_args, _ = tree_flatten(self.args)
-        flat_kwargs, _ = tree_flatten(tuple(sorted(self.kwargs.items())))
+        # The tree structure must be part of the key: None is an EMPTY
+        # subtree to jax pytrees, so flattening alone maps e.g. the index
+        # keys (None, None, :, None) and (None, None, None, :) to the same
+        # leaves — and CSE would silently merge different ops.
+        flat_args, spec_a = tree_flatten(self.args)
+        flat_kwargs, spec_k = tree_flatten(tuple(sorted(self.kwargs.items())))
         return BoundSymbolRHS(
             self.sym.id,
-            tuple(keyify(a) for a in flat_args),
-            tuple(keyify(a) for a in flat_kwargs),
+            (str(spec_a),) + tuple(keyify(a) for a in flat_args),
+            (str(spec_k),) + tuple(keyify(a) for a in flat_kwargs),
         )
 
     # -- rewriting -----------------------------------------------------------

@@ -79,6 +79,20 @@ def init(
             **kwargs,
         )
         _initialized = True
+    else:
+        # A repeat call with explicit arguments that CONTRADICT the live
+        # runtime is a misconfigured bootstrap, not idempotence (ADVICE r3:
+        # silently ignoring the args masks wiring bugs in multi-host launch
+        # scripts).
+        for name, given, active in (
+            ("process_id", process_id, jax.process_index()),
+            ("num_processes", num_processes, jax.process_count()),
+        ):
+            if given is not None and given != active:
+                raise RuntimeError(
+                    f"distributed.init(): {name}={given} conflicts with the active "
+                    f"runtime ({name}={active}); call shutdown() first to rebootstrap"
+                )
     return {
         "process_id": jax.process_index(),
         "num_processes": jax.process_count(),

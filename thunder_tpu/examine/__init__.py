@@ -16,21 +16,82 @@ from thunder_tpu.core.pytree import tree_flatten
 from thunder_tpu.core.trace import TraceCtx
 
 
+def _collect_unsupported(fn: Callable, args, kwargs) -> tuple[list[str], Optional[str]]:
+    """One eager pass under a recording TorchFunctionMode: every torch call
+    is checked for ltorch coverage and then executed FOR REAL, so ALL
+    unsupported ops are enumerated in a single run (reference:
+    examine/__init__.py:17-49 — the same collector design). Returns
+    (unsupported op names, user error or None)."""
+    import torch
+    from torch.overrides import TorchFunctionMode
+
+    from thunder_tpu.core.langctxs import Languages, resolve_language
+    from thunder_tpu.torch import torch_function_map
+
+    fmap = torch_function_map()
+    ctx = resolve_language(Languages.TORCH)
+    seen: list[str] = []
+    seen_set: set[str] = set()
+
+    # Mirrors frontend/dispatch.py: mapped directly, or resolvable as an
+    # ltorch method by name.
+    def covered(func) -> bool:
+        if func in fmap:
+            return True
+        name = getattr(func, "__name__", None)
+        return bool(name and ctx.has_method(name))
+
+    class Collector(TorchFunctionMode):
+        def __torch_function__(self, func, types, f_args=(), f_kwargs=None):
+            name = getattr(func, "__name__", "")
+            # attribute-descriptor plumbing (Tensor.real's __get__ etc.) is
+            # not an op the user wrote
+            if not covered(func) and not (name.startswith("__") and name.endswith("__")):
+                label = getattr(func, "__qualname__", name or repr(func))
+                if label not in seen_set:
+                    seen_set.add(label)
+                    seen.append(label)
+            return func(*f_args, **(f_kwargs or {}))
+
+    user_error: Optional[str] = None
+    try:
+        with Collector():
+            fn(*args, **kwargs)
+    except Exception as e:  # noqa: BLE001 — eager failure is a USER bug, reported separately
+        user_error = f"{type(e).__name__}: {e}"
+    return seen, user_error
+
+
 def examine(fn: Callable, *args, **kwargs) -> dict:
     """Report whether ``fn`` can be traced, and which torch operations are
-    not supported (reference: examine/__init__.py:49 — there via a
-    TorchFunctionMode collector; here by running the acquisition itself and
-    collecting dispatch failures)."""
-    import torch
+    not supported (reference: examine/__init__.py:49).
 
-    from thunder_tpu.frontend.module import ThunderModule
+    Torch-facing callables get the full collector pass — a model with three
+    unsupported ops lists all three, and an exception raised by the model
+    itself is reported as ``user_error`` rather than conflated with missing
+    coverage. The acquisition itself is then attempted to produce a trace."""
+    try:
+        import torch
+    except ImportError:
+        torch = None
+
     from thunder_tpu.api import trace_program
+    from thunder_tpu.frontend.module import ThunderModule
 
     unsupported: list[str] = []
     report: dict[str, Any] = {"supported": False, "unsupported_ops": unsupported, "trace": None}
 
+    is_torch_module = torch is not None and isinstance(fn, torch.nn.Module)
+    if is_torch_module:
+        ops, user_error = _collect_unsupported(fn, args, kwargs)
+        unsupported.extend(ops)
+        if user_error is not None:
+            report["user_error"] = user_error
+        if unsupported or user_error:
+            return report
+
     try:
-        if isinstance(fn, torch.nn.Module):
+        if is_torch_module:
             tm = ThunderModule(fn)
             entry = tm._compile(args, kwargs)
             comp = entry["traces"][0]

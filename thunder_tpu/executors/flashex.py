@@ -1,27 +1,50 @@
-"""Flash-attention executor: Pallas TPU kernels claiming SDPA whole.
+"""Flash-attention executor: Pallas TPU splash-attention kernels claiming SDPA whole.
 
 Reference parity: the cuDNN/sdpa executor seats
 (thunder/executors/cudnnex.py:44 — fused SDPA fwd/bwd via cuDNN's graph
-API; sdpaex.py:26 — flash/mem-efficient backend selection). Here the fused
-kernels are the public JAX Pallas TPU flash-attention kernels (Mosaic), an
+API, including the attn-mask bias input at cudnnex.py:81-92; sdpaex.py:26 —
+flash/mem-efficient backend selection, incl. the head-dim padding at
+sdpaex.py:49). Here the fused kernels are JAX's production splash-attention
+Pallas TPU kernels (block-sparse flash with native causal skipping), an
 external kernel library in exactly the sense cuDNN is to the reference.
 
 Claims:
-- ``torch.scaled_dot_product_attention`` (forward) — online-softmax flash
-  kernel; no (B, H, S, S) score materialization, the win that moves the
-  single-chip memory ceiling (bench.py).
+- ``torch.scaled_dot_product_attention`` (forward) — online-softmax flash;
+  no (B, H, S, S) score materialization.
 - ``torch.sdpa_bwd`` (backward composite emitted by the autodiff rule) —
-  flash backward via the kernel's custom VJP with forward recompute.
+  splash backward kernels via the kernel's custom VJP.
 
-Checker gates (fall back to the decomposition otherwise): no mask, no
-dropout, q/kv seq lengths equal and divisible by the 128 block, head dim
-≤ 256.
+Mask support (the reference's cudnnex builds its graph with a bias input;
+splash is mask-structured instead, so masks are handled by shape class):
+- ``attn_mask=None`` (+ optional ``is_causal``): claimed directly.
+- Key-padding masks — bool/additive of shape (B, S), (S,), (B, 1, 1, S),
+  (B, 1, S): lowered to splash segment-ids. Additive key-padding masks are
+  runtime-verified (entries must be 0 or very negative); on mismatch a
+  ``lax.cond`` falls back to the exact decomposed SDPA, so claiming is
+  always value-correct.
+- 4D float/bool masks (B, 1, Sq, Skv) — the shape HF builds for padded
+  causal batches: the kv-validity row is extracted at runtime, the mask is
+  rebuilt as causal∧padding (and full∧padding), and compared; the flash
+  path executes only when the rebuild matches (other masks — e.g. ALiBi
+  biases — take the decomposed branch of the same ``lax.cond``).
+  Positions whose query is padding are undefined in the flash branch
+  (finite garbage, exactly like the reference's flash kernels) — HF-style
+  consumers never read them.
+- Unequal q/kv lengths and lengths not divisible by 128 are handled by
+  in-executor padding with segment-ids (reference bar: sdpaex.py:49 pads
+  head dims to stay on the fast path).
+
+Tuning knobs (env): THUNDER_FLASH_IMPL=splash|legacy,
+THUNDER_FLASH_BQ/BKV/BQ_DKV/BKV_DKV, THUNDER_FLASH_FUSED_BWD=1|0.
+Block-size defaults were measured on v5e (see commit history / r3-r4
+ablations).
 """
 
 from __future__ import annotations
 
 import math
-from functools import partial
+import os
+from functools import lru_cache, partial
 from typing import Optional
 
 from thunder_tpu.core.proxies import TensorProxy, pyval
@@ -31,7 +54,36 @@ ex = OperatorExecutor("flash")
 register_executor(ex)
 add_default_executor(ex, front=True)
 
-_BLOCK = 128
+_PAD = 128  # sequence alignment quantum (Mosaic lane width)
+_NEG_BIG = -1e9  # additive-mask entries at or below this count as "masked"
+
+
+def _impl_name() -> str:
+    return os.environ.get("THUNDER_FLASH_IMPL", "splash")
+
+
+def _blk(name: str, dflt: int) -> int:
+    return int(os.environ.get(name, dflt))
+
+
+def _fused_bwd() -> bool:
+    return os.environ.get("THUNDER_FLASH_FUSED_BWD", "1") == "1"
+
+
+def _interpret() -> bool:
+    import jax
+
+    return jax.default_backend() == "cpu"
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    # THUNDER_FLASH_FORCE=1 lets tests exercise the splash path on the CPU
+    # mesh via Pallas interpret mode.
+    if os.environ.get("THUNDER_FLASH_FORCE") == "1":
+        return True
+    return jax.default_backend() != "cpu"
 
 
 def _sdpa_bound(args, kwargs) -> dict:
@@ -44,33 +96,340 @@ def _sdpa_bound(args, kwargs) -> dict:
     return b
 
 
+# =============================================================================
+# Mask classification (shape-level; value checks happen at runtime)
+# =============================================================================
+
+
+def _is_bool(x) -> bool:
+    from thunder_tpu.core import dtypes
+
+    return dtypes.is_boolean_dtype(x.dtype)
+
+
+def _mask_kind(m, q, k) -> str:
+    """'none' | 'keypad' | 'keypad_verify' | 'verify4d' | 'no'."""
+    if m is None:
+        return "none"
+    if not (isinstance(m, TensorProxy) or hasattr(m, "shape")):
+        return "no"
+    if getattr(m, "requires_grad", False):
+        return "no"  # no mask cotangent from the fused kernel
+    B, Tq = q.shape[0], q.shape[-2]
+    Tkv = k.shape[-2]
+    shp = tuple(m.shape)
+    # torch-legal key-padding shapes: broadcastable to (B, H, Sq, Skv) while
+    # constant over the query axis.
+    keypad_shapes = {(Tkv,), (B, 1, 1, Tkv), (1, 1, 1, Tkv)}
+    if shp in keypad_shapes:
+        return "keypad" if _is_bool(m) else "keypad_verify"
+    if len(shp) == 4 and shp[0] in (1, B) and shp[1] == 1 and shp[2] == Tq and shp[3] == Tkv:
+        return "verify4d"
+    return "no"
+
+
+def _pad_amt(t: int) -> int:
+    return (-t) % _PAD
+
+
+def _dtype_ok(q, k, v) -> bool:
+    """Half-precision only, like the reference's fused-SDPA executors
+    (cudnnex.py:60 / sdpaex.py checkers reject fp32): the TPU kernel's
+    internal MXU passes are bf16, so claiming f32 would silently lose the
+    HIGHEST-precision semantics the decomposition provides."""
+    from thunder_tpu.core import dtypes
+
+    def half(t):
+        dt = dtypes.to_dtype(t.dtype)
+        return dt in (dtypes.bfloat16, dtypes.float16)
+
+    return half(q) and half(k) and half(v)
+
+
 def _shapes_ok(q, k) -> bool:
     if not (isinstance(q, TensorProxy) or hasattr(q, "shape")):
         return False
     if len(q.shape) != 4 or len(k.shape) != 4:
         return False
     S, L, D = q.shape[-2], k.shape[-2], q.shape[-1]
-    return S == L and S % _BLOCK == 0 and D <= 256
-
-
-def _on_tpu() -> bool:
-    import jax
-
-    return jax.default_backend() != "cpu"
+    if D > 256:
+        return False
+    # Below half a block of real work, padding waste dominates any kernel
+    # win — keep the cheap decomposition.
+    return S >= _PAD // 2 and L >= _PAD // 2
 
 
 def _sdpa_checker(*args, **kwargs) -> bool:
     b = _sdpa_bound(args, kwargs)
-    return (
-        _on_tpu()
-        and b["attn_mask"] is None
-        and float(pyval(b["dropout_p"])) == 0.0
-        and _shapes_ok(b["query"], b["key"])
+    q, k = b["query"], b["key"]
+    if not (_on_tpu() and float(pyval(b["dropout_p"])) == 0.0 and _shapes_ok(q, k)
+            and _dtype_ok(q, k, b["value"])):
+        return False
+    if _impl_name() == "legacy":
+        S, L = q.shape[-2], k.shape[-2]
+        return b["attn_mask"] is None and S == L and S % _PAD == 0
+    kind = _mask_kind(b["attn_mask"], q, k)
+    if kind == "no":
+        return False
+    if kind != "none" and b["is_causal"]:
+        return False  # torch: is_causal and attn_mask are mutually exclusive
+    return True
+
+
+def _bwd_checker(g, query, key, value, attn_mask=None, is_causal=False, scale=None, enable_gqa=False) -> bool:
+    if not (_on_tpu() and _shapes_ok(query, key) and _dtype_ok(query, key, value)):
+        return False
+    if _impl_name() == "legacy":
+        S, L = query.shape[-2], key.shape[-2]
+        return attn_mask is None and S == L and S % _PAD == 0
+    return _mask_kind(attn_mask, query, key) != "no"
+
+
+# =============================================================================
+# splash kernel construction (cached per static configuration)
+# =============================================================================
+
+
+def _fit_block(pref: int, t: int) -> int:
+    b = min(pref, t)
+    b -= b % _PAD
+    b = max(b, _PAD)
+    while t % b:
+        b -= _PAD
+    return max(b, _PAD)
+
+
+@lru_cache(maxsize=64)
+def _splash_kernel(H: int, Tq: int, Tkv: int, causal: bool, offset: int, interpret: bool,
+                   bq: int, bkv: int, bqd: int, bkd: int, fused: bool, downcast: bool):
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as sm,
     )
 
+    block_sizes = sk.BlockSizes(
+        block_q=bq, block_kv=bkv, block_kv_compute=bkv,
+        block_q_dkv=bqd, block_kv_dkv=bkd, block_kv_dkv_compute=bkd,
+        block_q_dq=None if fused else bqd,
+        block_kv_dq=None if fused else bkd,
+        use_fused_bwd_kernel=fused,
+    )
+    if causal:
+        head_mask = sm.CausalMask((Tq, Tkv), offset=offset)
+    else:
+        head_mask = sm.FullMask((Tq, Tkv))
+    mask = sm.MultiHeadMask([head_mask for _ in range(H)])
+    import jax
 
-def _bwd_checker(g, query, key, value, is_causal=False, scale=None, enable_gqa=False) -> bool:
-    return _on_tpu() and _shapes_ok(query, key)
+    # The kernel object (mask-info arrays) is cached across jit traces —
+    # build it outside the ambient trace so no tracer leaks into the cache.
+    with jax.ensure_compile_time_eval():
+        return sk.make_splash_mha(
+            mask=mask, head_shards=1, q_seq_shards=1, block_sizes=block_sizes,
+            interpret=interpret, downcast_smem_data=downcast,
+        )
+
+
+def _splash_sdpa(q, k, v, *, causal: bool, scale: float, kv_valid=None, q_valid=None):
+    """Run splash attention with in-executor sequence padding.
+
+    q: (B, H, Tq, D); k/v: (B, H, Tkv, D) (already GQA-expanded).
+    kv_valid/q_valid: optional bool (B, T) — False positions never attend /
+    are never attended to (lowered to splash segment-ids). Output positions
+    with an invalid query are finite garbage and are expected to be ignored
+    by the consumer (their cotangents are zero in the backward, so no
+    garbage reaches dq/dk/dv at valid positions).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.pallas.ops.tpu.splash_attention import splash_attention_kernel as sk
+
+    B, H, Tq, D = q.shape
+    Tkv = k.shape[-2]
+    off = Tkv - Tq  # bottom-right causal alignment, matching the decomposition
+    pq, pkv = _pad_amt(Tq), _pad_amt(Tkv)
+
+    need_seg = kv_valid is not None or q_valid is not None or pq or pkv
+    if pq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0)))
+    if pkv:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pkv), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pkv), (0, 0)))
+
+    Tqp, Tkvp = Tq + pq, Tkv + pkv
+    kernel = _splash_kernel(
+        H, Tqp, Tkvp, causal, off, _interpret(),
+        _fit_block(_blk("THUNDER_FLASH_BQ", 512), Tqp),
+        _fit_block(_blk("THUNDER_FLASH_BKV", 512), Tkvp),
+        _fit_block(_blk("THUNDER_FLASH_BQ_DKV", 512), Tqp),
+        _fit_block(_blk("THUNDER_FLASH_BKV_DKV", 512), Tkvp),
+        _fused_bwd(),
+        # bf16 data is already narrow; keep f32 inputs at full precision in
+        # SMEM (the downcast costs ~1e-3 abs error on f32 workloads).
+        q.dtype == jnp.bfloat16,
+    )
+    qs = (q * jnp.asarray(scale, dtype=q.dtype)).astype(q.dtype)
+
+    with jax.enable_x64(False):
+        if need_seg:
+            qv = jnp.ones((B, Tq), dtype=jnp.bool_) if q_valid is None else q_valid
+            kvv = jnp.ones((B, Tkv), dtype=jnp.bool_) if kv_valid is None else kv_valid
+            qv = jnp.pad(qv, ((0, 0), (0, pq)))
+            kvv = jnp.pad(kvv, ((0, 0), (0, pkv)))
+            seg = sk.SegmentIds(q=qv.astype(jnp.int32), kv=kvv.astype(jnp.int32))
+            out = jax.vmap(kernel, in_axes=(0, 0, 0, sk.SegmentIds(q=0, kv=0)))(qs, k, v, seg)
+        else:
+            out = jax.vmap(kernel)(qs, k, v)
+    return out[..., :Tq, :] if pq else out
+
+
+# =============================================================================
+# Runtime dispatch: mask → flash path (+ verified cond fallback)
+# =============================================================================
+
+
+def _xla_sdpa(q, k, v, attn_mask, causal: bool, scale: float):
+    """Exact decomposed SDPA (the lax.cond fallback branch)."""
+    import jax
+    import jax.numpy as jnp
+
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    Tq, Tkv = q.shape[-2], k.shape[-2]
+    if causal:
+        i = jnp.arange(Tq)[:, None]
+        j = jnp.arange(Tkv)[None, :]
+        s = jnp.where(i + (Tkv - Tq) >= j, s, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            s = jnp.where(attn_mask, s, -jnp.inf)
+        else:
+            s = s + attn_mask.astype(jnp.float32)
+    # torch-sdpa safe-softmax: fully-masked rows yield zeros, not NaN
+    dead = jnp.max(s, axis=-1, keepdims=True) == -jnp.inf
+    p = jnp.where(dead, 0.0, jax.nn.softmax(s, axis=-1)).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _mask_kind_rt(m, q, k) -> str:
+    """Runtime twin of _mask_kind (on concrete arrays)."""
+    import jax.numpy as jnp
+
+    class _Shim:
+        def __init__(self, x):
+            self.shape = x.shape
+            self.requires_grad = False
+            self.dtype = x.dtype
+
+    if m is None:
+        return "none"
+    B, Tq, Tkv = q.shape[0], q.shape[-2], k.shape[-2]
+    shp = tuple(m.shape)
+    if shp in {(Tkv,), (B, 1, 1, Tkv), (1, 1, 1, Tkv)}:
+        return "keypad" if m.dtype == jnp.bool_ else "keypad_verify"
+    return "verify4d"
+
+
+def _sdpa_runtime(q, k, v, attn_mask, causal: bool, scale: float):
+    """Dispatch one SDPA call to splash, with runtime-verified fallbacks."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    B, H, Tq, D = q.shape
+    Tkv = k.shape[-2]
+    kind = _mask_kind_rt(attn_mask, q, k)
+
+    if kind == "none":
+        return _splash_sdpa(q, k, v, causal=causal, scale=scale)
+
+    if kind in ("keypad", "keypad_verify"):
+        m = jnp.reshape(attn_mask, (-1, Tkv))
+        m = jnp.broadcast_to(m, (B, Tkv))
+        if kind == "keypad":
+            kv_valid = m
+            return _splash_sdpa(q, k, v, causal=causal, scale=scale, kv_valid=kv_valid)
+        # additive key-padding: verify entries are 0 (keep) or <= _NEG_BIG (drop)
+        kv_valid = m == 0
+        ok = jnp.all(kv_valid | (m <= _NEG_BIG))
+        return lax.cond(
+            ok,
+            lambda q, k, v: _splash_sdpa(q, k, v, causal=causal, scale=scale, kv_valid=kv_valid),
+            lambda q, k, v: _xla_sdpa(q, k, v, attn_mask, causal, scale),
+            q, k, v,
+        )
+
+    # verify4d: (1|B, 1, Tq, Tkv) — HF's padded causal (or full) mask.
+    m4 = jnp.broadcast_to(attn_mask, (B, 1, Tq, Tkv))[:, 0]  # (B, Tq, Tkv)
+    if m4.dtype == jnp.bool_:
+        visible = m4
+    else:
+        visible = m4 == 0
+        # additive entries must be 0/very-negative for the rebuild to be valid
+        additive_ok = jnp.all(visible | (m4 <= _NEG_BIG))
+    kv_valid = visible[:, -1, :]  # last query row sees every valid key (causal)
+    # q validity: self-attention ⇒ q tokens are the last Tq of the kv axis
+    q_valid = kv_valid[:, Tkv - Tq:]
+    i = jnp.arange(Tq)[:, None]
+    j = jnp.arange(Tkv)[None, :]
+    causal_tri = i + (Tkv - Tq) >= j  # (Tq, Tkv)
+    rebuild_causal = causal_tri[None] & kv_valid[:, None, :]
+    rebuild_full = jnp.broadcast_to(kv_valid[:, None, :], visible.shape)
+    rows_ok = q_valid[:, :, None]  # only rows with a valid query must match
+    ok_causal = jnp.all((rebuild_causal == visible) | ~rows_ok)
+    ok_full = jnp.all((rebuild_full == visible) | ~rows_ok)
+    if m4.dtype != jnp.bool_:
+        ok_causal = ok_causal & additive_ok
+        ok_full = ok_full & additive_ok
+
+    def flash_causal(q, k, v):
+        return _splash_sdpa(q, k, v, causal=True, scale=scale, kv_valid=kv_valid, q_valid=q_valid)
+
+    def flash_full(q, k, v):
+        return _splash_sdpa(q, k, v, causal=False, scale=scale, kv_valid=kv_valid, q_valid=q_valid)
+
+    def fallback(q, k, v):
+        return lax.cond(
+            ok_full, flash_full,
+            lambda q, k, v: _xla_sdpa(q, k, v, attn_mask, causal, scale),
+            q, k, v,
+        )
+
+    return lax.cond(ok_causal, flash_causal, fallback, q, k, v)
+
+
+# =============================================================================
+# Legacy kernel (THUNDER_FLASH_IMPL=legacy; unmasked, aligned shapes only)
+# =============================================================================
+
+
+def _legacy_flash(q, k, v, *, causal: bool, sm_scale: float):
+    import jax
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes, flash_attention
+
+    S = q.shape[-2]
+    # r3 block sweep: fwd 512 measured 1.6× faster than 128 at S=2048 on
+    # v5e; bwd 512 vs 256 cut the open_llama_3b train step 0.888→0.807.
+    def fit(pref):
+        b = min(pref, S)
+        while S % b:
+            b //= 2
+        return max(b, 1)
+
+    b = fit(512)
+    sizes = BlockSizes(
+        block_q=b, block_k_major=b, block_k=b, block_b=1,
+        block_q_major_dkv=b, block_k_major_dkv=b, block_k_dkv=b, block_q_dkv=b,
+        block_k_major_dq=b, block_k_dq=b, block_q_dq=b,
+    )
+    # The kernel's internal index math assumes 32-bit Python-int weak types;
+    # scope out the runtime's x64 mode while tracing it.
+    with jax.enable_x64(False):
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale, block_sizes=sizes)
+
+
+# =============================================================================
+# Claimed implementations
+# =============================================================================
 
 
 def _expand_gqa(k, v, H):
@@ -83,52 +442,29 @@ def _expand_gqa(k, v, H):
     return jnp.repeat(k, rep, axis=-3), jnp.repeat(v, rep, axis=-3)
 
 
-def _flash(q, k, v, *, causal: bool, sm_scale: float):
-    import jax
-    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes, flash_attention
-
-    S = q.shape[-2]
-    # Bigger blocks amortize the online-softmax bookkeeping: fwd 512 measured
-    # 1.6× faster than 128 at S=2048 on v5e (block sweep in commit history);
-    # bwd 512 vs 256 cut the open_llama_3b train step 0.888→0.807 s/iter
-    # (train MFU 0.482→0.530, r3 ablations). 1024 measured neutral vs 512.
-    def fit(pref):
-        b = min(pref, S)
-        while S % b:
-            b //= 2
-        return max(b, 1)
-
-    b, bb = fit(512), fit(512)
-    sizes = BlockSizes(
-        block_q=b, block_k_major=b, block_k=b, block_b=1,
-        block_q_major_dkv=bb, block_k_major_dkv=bb, block_k_dkv=bb, block_q_dkv=bb,
-        block_k_major_dq=bb, block_k_dq=bb, block_q_dq=bb,
-    )
-    # The kernel's internal index math assumes 32-bit Python-int weak types;
-    # scope out the runtime's x64 mode while tracing it.
-    with jax.enable_x64(False):
-        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale, block_sizes=sizes)
-
-
 def _sdpa_impl(*args, **kwargs):
     b = _sdpa_bound(args, kwargs)
     q, k, v = b["query"], b["key"], b["value"]
     H, D = q.shape[-3], q.shape[-1]
-    scale = b["scale"] if b["scale"] is not None else 1.0 / math.sqrt(D)
+    scale = float(b["scale"]) if b["scale"] is not None else 1.0 / math.sqrt(D)
     k, v = _expand_gqa(k, v, H)
-    return _flash(q, k, v, causal=bool(b["is_causal"]), sm_scale=float(scale))
+    if _impl_name() == "legacy":
+        return _legacy_flash(q, k, v, causal=bool(b["is_causal"]), sm_scale=scale)
+    return _sdpa_runtime(q, k, v, b["attn_mask"], bool(b["is_causal"]), scale)
 
 
-def _sdpa_bwd_impl(g, query, key, value, is_causal=False, scale=None, enable_gqa=False):
+def _sdpa_bwd_impl(g, query, key, value, attn_mask=None, is_causal=False, scale=None, enable_gqa=False):
     import jax
-    import jax.numpy as jnp
 
     H, D = query.shape[-3], query.shape[-1]
     G = key.shape[-3]
     sm_scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
     k, v = _expand_gqa(key, value, H)
 
-    f = partial(_flash, causal=bool(is_causal), sm_scale=sm_scale)
+    if _impl_name() == "legacy":
+        f = partial(_legacy_flash, causal=bool(is_causal), sm_scale=sm_scale)
+    else:
+        f = lambda q, k, v: _sdpa_runtime(q, k, v, attn_mask, bool(is_causal), sm_scale)
     with jax.enable_x64(False):
         _, vjp = jax.vjp(f, query, k, v)
         dq, dk, dv = vjp(g)

@@ -20,12 +20,21 @@ def torch_dispatch(func, types, args=(), kwargs=None):
     from thunder_tpu.core.pytree import tree_flatten
     from thunder_tpu.torch import torch_function_map
 
+    flat, _ = tree_flatten((args, kwargs))
+    has_proxy = any(isinstance(a, TensorProxy) for a in flat)
+    import torch as _torch
+
+    if not has_proxy and any(isinstance(a, _torch.Tensor) for a in flat):
+        # An op over concrete tensors only (e.g. mask bookkeeping on a real
+        # aux tensor inside a traced forward): run it for real — mapping it
+        # to ltorch would hand a torch.Tensor to proxy-only meta functions.
+        return func(*args, **kwargs)
+
     target = torch_function_map().get(func)
     if target is not None:
         return target(*args, **kwargs)
 
-    flat, _ = tree_flatten((args, kwargs))
-    if not any(isinstance(a, TensorProxy) for a in flat):
+    if not has_proxy:
         # Pure-torch call over concrete values (dtype queries, flag checks):
         # run it for real.
         return func(*args, **kwargs)

@@ -1,0 +1,113 @@
+"""Sharp-edge interception for tracing-unsafe Python.
+
+Reference parity: thunder/core/jit_ext.py `_minimal_lookaside:344` routes
+``random.*`` (and friends) through the interpreter's sharp-edges machinery,
+and `_general_jit_sharp_edge:468` reports them per the policy
+(thunder/core/options.py:146). This frontend has no bytecode VM, so the
+same surface is covered by *scoped patching*: while a trace is being
+acquired, the known nondeterminism entry points — the ``random`` module,
+``time`` clocks, and ``os.environ`` reads — report through
+``common.sharp_edge()`` (allow → silent, warn → ThunderSharpEdgeWarning,
+error → ThunderSharpEdgeError) and then execute normally, so under the
+default policy behavior is unchanged but the observed value is known to be
+baked into the cached trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+from thunder_tpu.common import sharp_edge
+
+_RANDOM_FNS = (
+    "random", "randint", "uniform", "randrange", "choice", "choices",
+    "shuffle", "sample", "gauss", "normalvariate", "betavariate",
+    "expovariate", "lognormvariate", "vonmisesvariate", "paretovariate",
+    "weibullvariate", "triangular", "getrandbits", "randbytes",
+)
+_TIME_FNS = ("time", "time_ns", "perf_counter", "perf_counter_ns", "monotonic", "monotonic_ns")
+
+
+def _reporting(mod_name: str, fn_name: str, fn):
+    def wrapper(*args, **kwargs):
+        sharp_edge(
+            f"call to {mod_name}.{fn_name}() while tracing — the returned value is "
+            f"baked into the compiled program and will NOT be re-evaluated on later calls"
+        )
+        return fn(*args, **kwargs)
+
+    wrapper.__name__ = fn_name
+    return wrapper
+
+
+class _ReportingEnviron:
+    """os.environ stand-in: reads report as sharp edges, everything else
+    forwards (reference: env reads inside a traced forward are baked
+    configuration, jit_ext.py sharp-edge surface)."""
+
+    def __init__(self, real):
+        object.__setattr__(self, "_real", real)
+
+    def _report(self, key):
+        sharp_edge(
+            f"read of os.environ[{key!r}] while tracing — the value is baked into "
+            f"the compiled program"
+        )
+
+    def __getitem__(self, key):
+        self._report(key)
+        return self._real[key]
+
+    def get(self, key, default=None):
+        self._report(key)
+        return self._real.get(key, default)
+
+    def __contains__(self, key):
+        self._report(key)
+        return key in self._real
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_real"), name)
+
+    def __setitem__(self, key, value):
+        self._real[key] = value
+
+    def __delitem__(self, key):
+        del self._real[key]
+
+    def __iter__(self):
+        return iter(self._real)
+
+    def __len__(self):
+        return len(self._real)
+
+
+@contextlib.contextmanager
+def sharp_edge_interceptors():
+    """Scoped patches over the nondeterminism surface, active while the
+    user's function executes under the tracer."""
+    import os
+    import random
+    import time
+
+    saved: list[tuple[Any, str, Any]] = []
+
+    def patch(obj, name, value):
+        saved.append((obj, name, getattr(obj, name)))
+        setattr(obj, name, value)
+
+    try:
+        for fn_name in _RANDOM_FNS:
+            fn = getattr(random, fn_name, None)
+            if fn is not None:
+                patch(random, fn_name, _reporting("random", fn_name, fn))
+        for fn_name in _TIME_FNS:
+            fn = getattr(time, fn_name, None)
+            if fn is not None:
+                patch(time, fn_name, _reporting("time", fn_name, fn))
+        patch(os, "environ", _ReportingEnviron(os.environ))
+        yield
+    finally:
+        for obj, name, orig in reversed(saved):
+            setattr(obj, name, orig)

@@ -153,9 +153,39 @@ def empty(*size, dtype=None, device=None, requires_grad: bool = False):
     return clang.full(tuple(shape), 0, device=device, dtype=to_dtype(dtype) or dtypes.float32)
 
 
-@torchsymbol("torch.zeros_like", method_name="new_zeros")
+@torchsymbol("torch.zeros_like")
 def zeros_like(a, *, dtype=None, device=None, requires_grad: bool = False):
     return clang.zeros_like(a, device=device, dtype=to_dtype(dtype))
+
+
+def _new_factory_shape(size) -> tuple:
+    if len(size) == 1 and isinstance(size[0], (tuple, list)):
+        return tuple(size[0])
+    return tuple(size)
+
+
+@torchsymbol("torch.Tensor.new_zeros", method_name="new_zeros")
+def new_zeros(a, *size, dtype=None, device=None, requires_grad: bool = False):
+    return clang.full(_new_factory_shape(size), 0, device=device or a.device,
+                      dtype=to_dtype(dtype) or a.dtype)
+
+
+@torchsymbol("torch.Tensor.new_ones", method_name="new_ones")
+def new_ones(a, *size, dtype=None, device=None, requires_grad: bool = False):
+    return clang.full(_new_factory_shape(size), 1, device=device or a.device,
+                      dtype=to_dtype(dtype) or a.dtype)
+
+
+@torchsymbol("torch.Tensor.new_full", method_name="new_full")
+def new_full(a, size, fill_value, *, dtype=None, device=None, requires_grad: bool = False):
+    return clang.full(tuple(size), fill_value, device=device or a.device,
+                      dtype=to_dtype(dtype) or a.dtype)
+
+
+@torchsymbol("torch.Tensor.new_empty", method_name="new_empty")
+def new_empty(a, *size, dtype=None, device=None, requires_grad: bool = False):
+    return clang.full(_new_factory_shape(size), 0, device=device or a.device,
+                      dtype=to_dtype(dtype) or a.dtype)
 
 
 @torchsymbol("torch.ones_like")
@@ -348,6 +378,7 @@ def stack(tensors, dim: int = 0):
 
 @torchsymbol("torch.chunk", method_name="chunk")
 def chunk(a, chunks: int, dim: int = 0):
+    check(int(pyval(chunks)) > 0, lambda: f"chunk expects `chunks` to be greater than 0, got {chunks}")
     return clang.chunk(a, int(pyval(chunks)), int(pyval(dim)))
 
 
@@ -948,6 +979,7 @@ def einsum(equation: str, *operands):
 def embedding(indices, weight, padding_idx=None, max_norm=None, norm_type: float = 2.0,
               scale_grad_by_freq: bool = False, sparse: bool = False):
     check(max_norm is None, "embedding max_norm is not supported")
+    check(weight.ndim == 2, lambda: f"embedding weight must be rank 2, got shape {tuple(weight.shape)}")
     return clang.embedding(indices, weight)
 
 
@@ -1141,9 +1173,19 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p: f
         else:
             scores = clang.add(scores, clang.maybe_convert_to_dtype(attn_mask, dtypes.float32))
 
-    probs = softmax(scores, -1)
+    probs = _safe_softmax(scores)
     probs = clang.maybe_convert_to_dtype(probs, value.dtype)
     return clang.matmul(probs, value)
+
+
+def _safe_softmax(scores):
+    """torch-sdpa semantics: a fully-masked row (all -inf) produces ZEROS,
+    not NaN (torch's math backend safe-softmax) — without this, padding
+    rows poison later layers through 0·NaN products."""
+    row_max = clang.amax(scores, (-1,), True)
+    probs = softmax(scores, -1)
+    dead = clang.eq(row_max, -float("inf"))
+    return clang.where(clang.expand_to(dead, probs.shape), clang.full_like(probs, 0.0), probs)
 
 
 # =============================================================================
@@ -1152,11 +1194,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p: f
 
 
 @torchsymbol(id="torch.sdpa_bwd")
-def sdpa_bwd(g, query, key, value, is_causal: bool = False, scale: Optional[float] = None,
-             enable_gqa: bool = False):
-    """(dq, dk, dv) of causal/plain SDPA by recompute — the flash executor
-    replaces this whole op with the Pallas flash-attention backward
-    (reference analogue: cudnnex's sdpa backward graph, cudnnex.py:375)."""
+def sdpa_bwd(g, query, key, value, attn_mask=None, is_causal: bool = False,
+             scale: Optional[float] = None, enable_gqa: bool = False):
+    """(dq, dk, dv) of causal/masked/plain SDPA by recompute — the flash
+    executor replaces this whole op with the Pallas flash-attention backward
+    (reference analogue: cudnnex's sdpa backward graph, cudnnex.py:375,
+    which likewise takes the attn-mask bias as an input)."""
     E = query.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(E)
     H = query.shape[-3]
@@ -1178,7 +1221,12 @@ def sdpa_bwd(g, query, key, value, is_causal: bool = False, scale: Optional[floa
     if is_causal:
         cmask = clang.diagonal_mask(S, L, offset=L - S, upper=False, device=query.device)
         s = clang.where(clang.expand_to(cmask, s.shape), s, clang.full_like(s, -float("inf")))
-    p = softmax(s, -1)
+    elif attn_mask is not None:
+        if dtypes.is_boolean_dtype(attn_mask.dtype):
+            s = clang.where(clang.expand_to(attn_mask, s.shape), s, clang.full_like(s, -float("inf")))
+        else:
+            s = clang.add(s, clang.maybe_convert_to_dtype(attn_mask, dtypes.float32))
+    p = _safe_softmax(s)
 
     dv = clang.matmul(clang.transpose(p, -2, -1), gf)
     dp = clang.matmul(gf, clang.transpose(vf, -2, -1))
@@ -1233,12 +1281,17 @@ def _register_composite_vjps():
 
     def _sdpa_checker(*args, **kwargs):
         b = _sdpa_args(args, kwargs)
-        return b["attn_mask"] is None and float(pyval(b["dropout_p"])) == 0.0
+        m = b["attn_mask"]
+        # Masked SDPA keeps the composite backward (no mask cotangent is
+        # produced) unless the mask itself requires grad.
+        mask_ok = m is None or not getattr(m, "requires_grad", False)
+        return mask_ok and float(pyval(b["dropout_p"])) == 0.0
 
     @register_vjp("torch.scaled_dot_product_attention", checker=_sdpa_checker)
     def _sdpa_vjp(bsym, g):
         b = _sdpa_args(bsym.args, bsym.kwargs)
-        dq, dk, dv = sdpa_bwd(g, b["query"], b["key"], b["value"], b["is_causal"], b["scale"], b["enable_gqa"])
+        dq, dk, dv = sdpa_bwd(g, b["query"], b["key"], b["value"], b["attn_mask"],
+                              b["is_causal"], b["scale"], b["enable_gqa"])
         grads = [None] * len(bsym.args)
         for i, name in enumerate(("query", "key", "value")):
             if i < len(bsym.args):
