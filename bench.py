@@ -84,10 +84,13 @@ def build_train(cfg_name: str, batch: int, seq: int):
     tgt = np.roll(idx, -1, axis=1).astype(np.int32)
 
     t0 = time.perf_counter()
+    from thunder_tpu.transforms.attention_residuals import save_sdpa_residuals
+
     comp = _trace_claim(lambda p, i, t: m.loss_fn(p, i, t, cfg), (params, idx, tgt))
     fw, bw = forward_and_backward_from_trace(comp)
-    fw, bw = rematerialize_forward_and_backward(fw, bw)
     executors = resolve_executors(None)
+    fw, bw = save_sdpa_residuals(fw, bw, executors)
+    fw, bw = rematerialize_forward_and_backward(fw, bw)
     fw_fn = transform_for_execution(fw, executors).python_callable()
     bw_fn = transform_for_execution(bw, executors).python_callable()
     trace_s = time.perf_counter() - t0

@@ -300,8 +300,10 @@ class TestEndToEndModel:
         vg = thunder_tpu.value_and_grad(lambda p, i, t: m.loss_fn(p, i, t, cfg))
         loss, grads = vg(params, idx, tgt)
         src = thunder_tpu.last_traces(vg)[-1].python()
-        assert "flash_scaled_dot_product_attention" in src
-        assert "flash_sdpa_bwd" in src
+        # the attention-residual pass upgrades eligible pairs to the
+        # no-recompute composites
+        assert "flash_sdpa_fwd_res" in src or "flash_scaled_dot_product_attention" in src
+        assert "flash_sdpa_bwd" in src  # matches both sdpa_bwd and sdpa_bwd_res
         assert "pallas_cross_entropy" in src
         assert np.isfinite(float(np.asarray(loss)))
 
@@ -310,3 +312,78 @@ class TestEndToEndModel:
         )
         loss_s, grads_s = slow(params, idx, tgt)
         np.testing.assert_allclose(float(np.asarray(loss)), float(np.asarray(loss_s)), rtol=1e-2)
+
+
+class TestAttentionResiduals:
+    """The attention-residual pass (transforms/attention_residuals.py,
+    reference: cudnnex.py:375 saved softmax stats): sdpa pairs rewrite to
+    fwd_res/bwd_res so the flash backward runs WITHOUT forward recompute."""
+
+    def _qkv(self):
+        return (_bt(2, 2, 128, 32), _bt(2, 2, 128, 32, seed=1), _bt(2, 2, 128, 32, seed=2))
+
+    def test_joint_pipeline_claims_and_matches(self):
+        q, k, v = self._qkv()
+
+        def loss(q, k, v):
+            o = ttorch.scaled_dot_product_attention(q, k, v, is_causal=True)
+            return ttorch.sum(o.float() * o.float())
+
+        fast = thunder_tpu.value_and_grad(loss)
+        slow = thunder_tpu.value_and_grad(loss, executors=jax_only)
+        lf, gf = fast(q, k, v)
+        ls, gs = slow(q, k, v)
+        src = thunder_tpu.last_traces(fast)[-1].python()
+        assert "flash_sdpa_fwd_res" in src and "flash_sdpa_bwd_res" in src
+        assert "flash_sdpa_bwd(" not in src  # recompute composite gone
+        np.testing.assert_allclose(float(lf), float(ls), rtol=2e-2)
+        for n, a, b in zip("qkv", gf, gs):
+            np.testing.assert_allclose(_f32(a), _f32(b), rtol=5e-2, atol=2e-2, err_msg=n)
+
+    def test_split_pipeline_matches(self):
+        import jax.numpy as jnp
+
+        from thunder_tpu.api import trace_program
+        from thunder_tpu.core import dtypes
+        from thunder_tpu.core.pytree import tree_flatten
+        from thunder_tpu.executors.passes import transform_for_execution
+        from thunder_tpu.models import gpt as m
+        from thunder_tpu.transforms.attention_residuals import save_sdpa_residuals
+        from thunder_tpu.transforms.autodiff import forward_and_backward_from_trace
+        from thunder_tpu.transforms.common import cse, dce
+        from thunder_tpu.transforms.rematerialization import rematerialize_forward_and_backward
+
+        cfg = m.GPTConfig(
+            name="res-test", block_size=128, vocab_size=128, padded_vocab_size=128,
+            n_layer=2, n_head=2, n_embd=64, rotary_percentage=1.0, parallel_residual=False,
+            bias=False, norm_class="RMSNorm", mlp_class="LLaMAMLP", intermediate_size=128,
+        )
+        params = m.init_params(cfg, dtype=dtypes.bfloat16, seed=0)
+        idx = np.random.RandomState(0).randint(0, 128, (2, 128)).astype(np.int32)
+        tgt = np.roll(idx, -1, 1).astype(np.int32)
+        flat_p, _ = tree_flatten((params,))
+
+        def build(executors, use_pass):
+            _, comp = trace_program(lambda p, i, t: m.loss_fn(p, i, t, cfg), (params, idx, tgt), {})
+            comp = cse(dce(comp))
+            fw, bw = forward_and_backward_from_trace(comp)
+            if use_pass:
+                fw, bw = save_sdpa_residuals(fw, bw, executors)
+            fw, bw = rematerialize_forward_and_backward(fw, bw)
+            bw_ex = transform_for_execution(bw, executors)
+            return (transform_for_execution(fw, executors).python_callable(),
+                    bw_ex.python_callable(), bw_ex.python())
+
+        fast = resolve_executors(None)
+        fwf, bwf, bw_src = build(fast, True)
+        assert "flash_sdpa_bwd_res" in bw_src and "flash_sdpa_bwd(" not in bw_src
+        loss_f, saved_f = fwf(*flat_p, idx, tgt)
+        grads_f = bwf(*saved_f, jnp.ones((), dtype=jnp.float32))
+
+        fws, bws, _ = build(jax_only, False)
+        loss_s, saved_s = fws(*flat_p, idx, tgt)
+        grads_s = bws(*saved_s, jnp.ones((), dtype=jnp.float32))
+
+        np.testing.assert_allclose(float(np.asarray(loss_f)), float(np.asarray(loss_s)), rtol=1e-2)
+        for a, b in zip(grads_f, grads_s):
+            np.testing.assert_allclose(_f32(a), _f32(b), rtol=5e-2, atol=2e-2)

@@ -283,6 +283,13 @@ def _compile_entry(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict)
         comp_trc = tt(comp_trc)
         computation_traces.append(comp_trc)
 
+    # Joint-trace attention-residual saving: when grad produced fw+bw in one
+    # trace, let the flash backward consume saved (out, lse) instead of
+    # recomputing the forward kernel (transforms/attention_residuals.py).
+    from thunder_tpu.transforms.attention_residuals import save_sdpa_residuals_joint
+
+    comp_trc = save_sdpa_residuals_joint(comp_trc, cd.executors_list)
+
     comp_trc = functionalize_rng_ops(comp_trc)
     if comp_trc.tags.get(RNG_TAG):
         computation_traces.append(comp_trc)

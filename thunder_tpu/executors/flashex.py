@@ -47,6 +47,8 @@ import os
 from functools import lru_cache, partial
 from typing import Optional
 
+import numpy as np
+
 from thunder_tpu.core.proxies import TensorProxy, pyval
 from thunder_tpu.extend import OperatorExecutor, add_default_executor, register_executor
 
@@ -201,7 +203,8 @@ def _fit_block(pref: int, t: int) -> int:
 
 @lru_cache(maxsize=64)
 def _splash_kernel(H: int, Tq: int, Tkv: int, causal: bool, offset: int, interpret: bool,
-                   bq: int, bkv: int, bqd: int, bkd: int, fused: bool, downcast: bool):
+                   bq: int, bkv: int, bqd: int, bkd: int, fused: bool, downcast: bool,
+                   save_res: bool = False):
     from jax.experimental.pallas.ops.tpu.splash_attention import (
         splash_attention_kernel as sk,
         splash_attention_mask as sm,
@@ -226,7 +229,7 @@ def _splash_kernel(H: int, Tq: int, Tkv: int, causal: bool, offset: int, interpr
     with jax.ensure_compile_time_eval():
         return sk.make_splash_mha(
             mask=mask, head_shards=1, q_seq_shards=1, block_sizes=block_sizes,
-            interpret=interpret, downcast_smem_data=downcast,
+            interpret=interpret, downcast_smem_data=downcast, save_residuals=save_res,
         )
 
 
@@ -477,5 +480,119 @@ def _sdpa_bwd_impl(g, query, key, value, attn_mask=None, is_causal=False, scale=
     return dq.astype(query.dtype), dk.astype(key.dtype), dv.astype(value.dtype)
 
 
+# =============================================================================
+# Residual-saving pair (transforms/attention_residuals.py; reference:
+# cudnnex.py:375 — bwd graph consumes the fwd's saved softmax stats)
+# =============================================================================
+
+
+def residual_eligible(q, k, v) -> bool:
+    """The attention-residual pass asks before rewriting: both sides must be
+    claimable without padding or masks (the no-recompute path keeps the
+    simplest geometry; everything else stays on the recompute composite)."""
+    if not (_on_tpu() and _impl_name() == "splash" and _dtype_ok(q, k, v)):
+        return False
+    if len(q.shape) != 4 or len(k.shape) != 4:
+        return False
+    S, L, D = q.shape[-2], k.shape[-2], q.shape[-1]
+    return S == L and S % _PAD == 0 and D <= 256
+
+
+def _fwd_res_checker(query, key, value, attn_mask=None, is_causal=False, scale=None, enable_gqa=False):
+    return attn_mask is None and residual_eligible(query, key, value)
+
+
+def _bwd_res_checker(g, query, key, value, out, lse, attn_mask=None, is_causal=False,
+                     scale=None, enable_gqa=False):
+    return attn_mask is None and residual_eligible(query, key, value)
+
+
+def _splash_fwd_res(q, k, v, *, causal: bool, scale: float):
+    import jax
+    import jax.numpy as jnp
+
+    B, H, Tq, D = q.shape
+    Tkv = k.shape[-2]
+    kernel = _splash_kernel(
+        H, Tq, Tkv, causal, Tkv - Tq, _interpret(),
+        _fit_block(_blk("THUNDER_FLASH_BQ", 512), Tq),
+        _fit_block(_blk("THUNDER_FLASH_BKV", 512), Tkv),
+        _fit_block(_blk("THUNDER_FLASH_BQ_DKV", 512), Tq),
+        _fit_block(_blk("THUNDER_FLASH_BKV_DKV", 512), Tkv),
+        _fused_bwd(),
+        q.dtype == jnp.bfloat16,
+        True,
+    )
+    qs = (q * jnp.asarray(scale, dtype=q.dtype)).astype(q.dtype)
+    with jax.enable_x64(False):
+        out, (lse,) = jax.vmap(kernel)(qs, k, v)
+    return out, lse[..., :Tq].astype(jnp.float32)
+
+
+def _sdpa_fwd_res_impl(query, key, value, attn_mask=None, is_causal=False, scale=None, enable_gqa=False):
+    H, D = query.shape[-3], query.shape[-1]
+    sm_scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
+    k, v = _expand_gqa(key, value, H)
+    return _splash_fwd_res(query, k, v, causal=bool(is_causal), scale=sm_scale)
+
+
+def _sdpa_bwd_res_impl(g, query, key, value, out, lse, attn_mask=None, is_causal=False,
+                       scale=None, enable_gqa=False):
+    """Direct splash backward from saved (out, lse) — no forward recompute
+    (the jax.vjp route re-runs the forward kernel to rebuild these exact
+    residuals; r4 profile: 24.5 ms/iter on the 3B bench)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.pallas.ops.tpu.splash_attention import splash_attention_kernel as sk
+
+    B, H, Tq, D = query.shape
+    G = key.shape[-3]
+    Tkv = key.shape[-2]
+    sm_scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
+    k, v = _expand_gqa(key, value, H)
+
+    kernel = _splash_kernel(
+        H, Tq, Tkv, bool(is_causal), Tkv - Tq, _interpret(),
+        _fit_block(_blk("THUNDER_FLASH_BQ", 512), Tq),
+        _fit_block(_blk("THUNDER_FLASH_BKV", 512), Tkv),
+        _fit_block(_blk("THUNDER_FLASH_BQ_DKV", 512), Tq),
+        _fit_block(_blk("THUNDER_FLASH_BKV_DKV", 512), Tkv),
+        _fused_bwd(),
+        query.dtype == jnp.bfloat16,
+        False,
+    )
+    kw = dict(kernel.kwargs)
+    qs = (query * jnp.asarray(sm_scale, dtype=query.dtype)).astype(query.dtype)
+
+    def one(qb, kb, vb, ob, lseb, gb):
+        res = (qb, kb, vb, None, None, ob, lseb, kernel.dq_mask_info, kernel.dkv_mask_info)
+        grads = sk._splash_attention_bwd(
+            False,
+            kw.get("mask_value", -0.7 * float(np.finfo(np.dtype("float32")).max)),
+            kw.get("is_mqa", False),
+            kw.get("block_sizes"),
+            kw.get("residual_checkpoint_name"),
+            kw.get("mask_function"),
+            kw.get("attn_logits_soft_cap"),
+            kw.get("interpret", False),
+            res,
+            gb,
+        )
+        return grads[3], grads[4], grads[5]
+
+    with jax.enable_x64(False):
+        dqs, dk, dv = jax.vmap(one)(qs, k, v, out, lse.astype(jnp.float32), g)
+    dq = dqs.astype(jnp.float32) * sm_scale  # fwd consumed q*scale
+
+    if G != H:
+        rep = H // G
+        bshape = dk.shape[:-3]
+        dk = dk.reshape(bshape + (G, rep) + dk.shape[-2:]).sum(axis=len(bshape) + 1)
+        dv = dv.reshape(bshape + (G, rep) + dv.shape[-2:]).sum(axis=len(bshape) + 1)
+    return dq.astype(query.dtype), dk.astype(key.dtype), dv.astype(value.dtype)
+
+
 ex.register_implementation("torch.scaled_dot_product_attention", fn=_sdpa_impl, checker=_sdpa_checker)
 ex.register_implementation("torch.sdpa_bwd", fn=_sdpa_bwd_impl, checker=_bwd_checker)
+ex.register_implementation("torch.sdpa_fwd_res", fn=_sdpa_fwd_res_impl, checker=_fwd_res_checker)
+ex.register_implementation("torch.sdpa_bwd_res", fn=_sdpa_bwd_res_impl, checker=_bwd_res_checker)

@@ -750,6 +750,9 @@ class ThunderModule:
                         "has_updates": has_updates, "value_guards": vguards}
 
             fw, bw = forward_and_backward_from_trace(comp)
+            from thunder_tpu.transforms.attention_residuals import save_sdpa_residuals
+
+            fw, bw = save_sdpa_residuals(fw, bw, executors)
             if self._jit_options.get("rematerialize", True):
                 from thunder_tpu.transforms.rematerialization import rematerialize_forward_and_backward
 

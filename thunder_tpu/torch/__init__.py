@@ -1246,6 +1246,103 @@ def sdpa_bwd(g, query, key, value, attn_mask=None, is_causal: bool = False,
     return dq, dk, dv
 
 
+@torchsymbol(id="torch.sdpa_fwd_res")
+def sdpa_fwd_res(query, key, value, attn_mask=None, is_causal: bool = False,
+                 scale: Optional[float] = None, enable_gqa: bool = False):
+    """SDPA returning ``(out, lse)`` where lse is the per-row logsumexp of
+    the scaled (masked) scores, f32 of shape (..., H, Sq).
+
+    This is the augmented forward the attention-residual pass
+    (transforms/attention_residuals.py) swaps in so the flash backward can
+    run from saved residuals instead of recomputing the forward kernel —
+    the reference's cudnnex saves exactly this softmax_stats tensor between
+    its fwd and bwd graphs (cudnnex.py:375)."""
+    E = query.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(E)
+    H = query.shape[-3]
+    G = key.shape[-3]
+    k, v = key, value
+    if enable_gqa and G != H:
+        rep = H // G
+        k = repeat_interleave(k, rep, -3)
+        v = repeat_interleave(v, rep, -3)
+
+    s = clang.matmul(clang.mul(query, scale), clang.transpose(k, -2, -1))
+    s = clang.maybe_convert_to_dtype(s, dtypes.float32)
+    S, L = query.shape[-2], key.shape[-2]
+    if is_causal:
+        cmask = clang.diagonal_mask(S, L, offset=L - S, upper=False, device=query.device)
+        s = clang.where(clang.expand_to(cmask, s.shape), s, clang.full_like(s, -float("inf")))
+    elif attn_mask is not None:
+        if dtypes.is_boolean_dtype(attn_mask.dtype):
+            s = clang.where(clang.expand_to(attn_mask, s.shape), s, clang.full_like(s, -float("inf")))
+        else:
+            s = clang.add(s, clang.maybe_convert_to_dtype(attn_mask, dtypes.float32))
+    m = clang.amax(s, (-1,), True)
+    lse = clang.add(clang.log(clang.sum(clang.exp(clang.sub(s, m)), (-1,), True)), m)
+    p = clang.exp(clang.sub(s, lse))
+    dead = clang.eq(m, -float("inf"))
+    p = clang.where(clang.expand_to(dead, p.shape), clang.full_like(p, 0.0), p)
+    out = clang.matmul(clang.maybe_convert_to_dtype(p, value.dtype), v)
+    return out, clang.squeeze(lse, (lse.ndim - 1,))
+
+
+@torchsymbol(id="torch.sdpa_bwd_res")
+def sdpa_bwd_res(g, query, key, value, out, lse, attn_mask=None, is_causal: bool = False,
+                 scale: Optional[float] = None, enable_gqa: bool = False):
+    """(dq, dk, dv) from saved residuals: probabilities are reconstructed as
+    exp(s − lse) instead of a fresh softmax — one reduction cheaper, and the
+    form the flash backward kernels consume (reference: cudnnex.py:375 feeds
+    its bwd graph the saved softmax stats)."""
+    E = query.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(E)
+    H = query.shape[-3]
+    G = key.shape[-3]
+    k, v = key, value
+    if enable_gqa and G != H:
+        rep = H // G
+        k = repeat_interleave(k, rep, -3)
+        v = repeat_interleave(v, rep, -3)
+
+    qf = clang.maybe_convert_to_dtype(query, dtypes.float32)
+    kf = clang.maybe_convert_to_dtype(k, dtypes.float32)
+    vf = clang.maybe_convert_to_dtype(v, dtypes.float32)
+    gf = clang.maybe_convert_to_dtype(g, dtypes.float32)
+
+    s = clang.mul(clang.matmul(qf, clang.transpose(kf, -2, -1)), scale)
+    S, L = query.shape[-2], key.shape[-2]
+    if is_causal:
+        cmask = clang.diagonal_mask(S, L, offset=L - S, upper=False, device=query.device)
+        s = clang.where(clang.expand_to(cmask, s.shape), s, clang.full_like(s, -float("inf")))
+    elif attn_mask is not None:
+        if dtypes.is_boolean_dtype(attn_mask.dtype):
+            s = clang.where(clang.expand_to(attn_mask, s.shape), s, clang.full_like(s, -float("inf")))
+        else:
+            s = clang.add(s, clang.maybe_convert_to_dtype(attn_mask, dtypes.float32))
+    lse_col = clang.unsqueeze(lse, lse.ndim)
+    p = clang.exp(clang.sub(s, clang.maybe_convert_to_dtype(lse_col, dtypes.float32)))
+
+    dv = clang.matmul(clang.transpose(p, -2, -1), gf)
+    dp = clang.matmul(gf, clang.transpose(vf, -2, -1))
+    # di = rowsum(dout * out) == rowsum(dp * p); the saved-out form avoids
+    # materializing dp*p twice
+    di = clang.sum(clang.mul(gf, clang.maybe_convert_to_dtype(out, dtypes.float32)), (-1,), True)
+    ds = clang.mul(p, clang.sub(dp, di))
+    dq = clang.mul(clang.matmul(ds, kf), scale)
+    dk = clang.mul(clang.matmul(clang.transpose(ds, -2, -1), qf), scale)
+
+    if enable_gqa and G != H:
+        rep = H // G
+        bshape = tuple(dk.shape[:-3])
+        dk = clang.sum(clang.reshape(dk, bshape + (G, rep) + tuple(dk.shape[-2:])), (len(bshape) + 1,))
+        dv = clang.sum(clang.reshape(dv, bshape + (G, rep) + tuple(dv.shape[-2:])), (len(bshape) + 1,))
+
+    dq = clang.maybe_convert_to_dtype(dq, query.dtype)
+    dk = clang.maybe_convert_to_dtype(dk, key.dtype)
+    dv = clang.maybe_convert_to_dtype(dv, value.dtype)
+    return dq, dk, dv
+
+
 @torchsymbol(id="torch.cross_entropy_bwd")
 def cross_entropy_bwd(g, input, target, ignore_index: int = -100, reduction: str = "mean"):
     """dlogits of fused cross-entropy: (softmax − onehot) · g/count. The
