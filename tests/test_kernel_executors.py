@@ -387,3 +387,56 @@ class TestAttentionResiduals:
         np.testing.assert_allclose(float(np.asarray(loss_f)), float(np.asarray(loss_s)), rtol=1e-2)
         for a, b in zip(grads_f, grads_s):
             np.testing.assert_allclose(_f32(a), _f32(b), rtol=5e-2, atol=2e-2)
+
+
+class TestPallasRope:
+    """Fused rotate-half ROPE kernel (pallasex): the decomposed form is
+    lane-misaligned at odd head sizes (e.g. 100); bwd is the same kernel
+    with -sin via the torch.apply_rope VJP rule."""
+
+    def _inputs(self, B=2, H=3, T=64, D=100):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32), dtype=jnp.bfloat16)
+        theta = (10000.0 ** (np.arange(0, D // 2) * -2.0 / D)).astype(np.float32)
+        freqs = np.arange(T, dtype=np.float32)[:, None] * theta[None, :]
+        emb = np.concatenate([freqs, freqs], 1)
+        cos = jnp.asarray(np.cos(emb), dtype=jnp.bfloat16)
+        sin = jnp.asarray(np.sin(emb), dtype=jnp.bfloat16)
+        return x, cos, sin
+
+    def test_fwd_claims_and_matches(self):
+        x, cos, sin = self._inputs()
+        f = lambda x, c, s: ttorch.apply_rope(x, c, s)
+        fast = thunder_tpu.jit(f)
+        got = _f32(fast(x, cos, sin))
+        assert "pallas_apply_rope" in thunder_tpu.last_traces(fast)[-1].python()
+        want = _f32(thunder_tpu.jit(f, executors=jax_only)(x, cos, sin))
+        np.testing.assert_allclose(got, want, rtol=3e-2, atol=4e-2)
+
+    def test_bwd_same_kernel(self):
+        x, cos, sin = self._inputs()
+
+        def loss(x, c, s):
+            o = ttorch.apply_rope(x, c, s)
+            return ttorch.sum(o.float() * o.float())
+
+        vgf = thunder_tpu.value_and_grad(loss)
+        vgs = thunder_tpu.value_and_grad(loss, executors=jax_only)
+        lf, gf = vgf(x, cos, sin)
+        ls, gs = vgs(x, cos, sin)
+        np.testing.assert_allclose(float(lf), float(ls), rtol=2e-2)
+        np.testing.assert_allclose(_f32(gf[0]), _f32(gs[0]), rtol=5e-2, atol=8e-2)
+
+    def test_partial_rotary_decomposes(self):
+        import jax.numpy as jnp
+
+        x, cos, sin = self._inputs(D=100)
+        x_wide = jnp.concatenate([x, x[..., :28]], axis=-1)  # hs=128 > n=100
+        f = lambda x, c, s: ttorch.apply_rope(x, c, s)
+        jf = thunder_tpu.jit(f)
+        got = _f32(jf(x_wide, cos, sin))
+        assert "pallas_apply_rope" not in thunder_tpu.last_traces(jf)[-1].python()
+        want = _f32(thunder_tpu.jit(f, executors=jax_only)(x_wide, cos, sin))
+        np.testing.assert_allclose(got, want, rtol=3e-2, atol=4e-2)

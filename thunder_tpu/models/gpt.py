@@ -254,17 +254,10 @@ def _rope_cache(T: int, config: GPTConfig, device, dtype):
 
 
 def _apply_rope(x, cos, sin, config: GPTConfig):
-    """x: (B, H, T, hs); rotate the first rope_n_elem features."""
-    n = config.rope_n_elem
-    half = n // 2
-    rot = x[..., :n]
-    x1 = rot[..., :half]
-    x2 = rot[..., half:]
-    rotated = ttorch.cat([-x2, x1], dim=-1)
-    roped = rot * cos + rotated * sin
-    if n == config.head_size:
-        return roped
-    return ttorch.cat([roped, x[..., n:]], dim=-1)
+    """x: (B, H, T, hs); rotate the first rope_n_elem features. Composite op
+    so the Pallas rope kernel claims it (pallasex; the decomposed
+    rotate-half is lane-misaligned at hs=100)."""
+    return ttorch.apply_rope(x, cos, sin)
 
 
 def _attention(x, p, cos, sin, config: GPTConfig):

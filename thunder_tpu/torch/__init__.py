@@ -1246,6 +1246,28 @@ def sdpa_bwd(g, query, key, value, attn_mask=None, is_causal: bool = False,
     return dq, dk, dv
 
 
+@torchsymbol(id="torch.apply_rope")
+def apply_rope(x, cos, sin):
+    """Rotate-half rotary embedding over the last dim (HF NeoX/Llama
+    convention; litgpt ``apply_rope``): x (..., T, hs), cos/sin (T, n) with
+    n ≤ hs built as cat([freqs, freqs]) — features beyond n pass through.
+
+    Kept composite so the Pallas rope kernel (pallasex) claims it whole:
+    the decomposed rotate-half (two 50-lane slices + concat at hs=100) is
+    badly lane-misaligned on the VPU — the r4 profile showed ~14 ms/iter of
+    (.., 50)-shaped fusions on the 3B bench."""
+    n = cos.shape[-1]
+    half = n // 2
+    rot = x[..., :n] if n != x.shape[-1] else x
+    x1 = rot[..., :half]
+    x2 = rot[..., half:]
+    rotated = cat([-x2, x1], dim=-1)
+    roped = rot * cos + rotated * sin
+    if n == x.shape[-1]:
+        return roped
+    return cat([roped, x[..., n:]], dim=-1)
+
+
 @torchsymbol(id="torch.sdpa_fwd_res")
 def sdpa_fwd_res(query, key, value, attn_mask=None, is_causal: bool = False,
                  scale: Optional[float] = None, enable_gqa: bool = False):
@@ -1407,6 +1429,14 @@ def _register_composite_vjps():
             bound.get("ignore_index", -100), bound.get("reduction", "mean"),
         )
         return (d,) + (None,) * (len(bsym.args) - 1)
+
+    @register_vjp("torch.apply_rope")
+    def _rope_vjp(bsym, g):
+        # y = x*cos + rot(x)*sin with rot adjoint = -rot and both cos/sin
+        # halves equal ⇒ dx = apply_rope(g, cos, -sin): the backward is the
+        # SAME composite (and the same Pallas kernel claims it).
+        x, cos, sin = bsym.args
+        return (apply_rope(g, cos, clang.neg(sin)), None, None)
 
 
 _register_composite_vjps()
