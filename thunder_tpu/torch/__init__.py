@@ -1246,6 +1246,30 @@ def sdpa_bwd(g, query, key, value, attn_mask=None, is_causal: bool = False,
     return dq, dk, dv
 
 
+@torchsymbol(id="torch.rms_norm_bwd")
+def rms_norm_bwd(g, a, weight, eps: float):
+    """(dx, dw) of last-dim RMSNorm — kept composite so the Pallas fused
+    norm kernel claims it whole (reference seat: the cudnn fused-norm
+    executor, cudnn_layernormex.py:134)."""
+    D = a.shape[-1]
+    compute_dtype = dtypes.float32 if a.dtype in (dtypes.bfloat16, dtypes.float16) else a.dtype
+    xf = clang.maybe_convert_to_dtype(a, compute_dtype)
+    gf = clang.maybe_convert_to_dtype(g, compute_dtype)
+    ms = clang.mean(clang.mul(xf, xf), (-1,), True)
+    rstd = clang.rsqrt(clang.add(ms, eps))
+    xhat = clang.mul(xf, rstd)
+    wg = gf if weight is None else clang.mul(gf, clang.maybe_convert_to_dtype(weight, compute_dtype))
+    dot = clang.mean(clang.mul(wg, xhat), (-1,), True)
+    dx = clang.mul(rstd, clang.sub(wg, clang.mul(xhat, dot)))
+    dx = clang.maybe_convert_to_dtype(dx, a.dtype)
+    if weight is None:
+        return dx, None
+    red_dims = tuple(range(a.ndim - 1))
+    dw = clang.sum(clang.mul(gf, xhat), red_dims) if red_dims else clang.mul(gf, xhat)
+    dw = clang.maybe_convert_to_dtype(dw, weight.dtype)
+    return dx, dw
+
+
 @torchsymbol(id="torch.apply_rope")
 def apply_rope(x, cos, sin):
     """Rotate-half rotary embedding over the last dim (HF NeoX/Llama
@@ -1429,6 +1453,22 @@ def _register_composite_vjps():
             bound.get("ignore_index", -100), bound.get("reduction", "mean"),
         )
         return (d,) + (None,) * (len(bsym.args) - 1)
+
+    def _rms_checker(a, normalized_shape, weight=None, eps=None):
+        return len(tuple(normalized_shape)) == 1  # last-dim norm only
+
+    @register_vjp("torch.rms_norm", checker=_rms_checker)
+    def _rms_norm_vjp(bsym, g):
+        bound = dict(zip(("a", "normalized_shape", "weight", "eps"), bsym.args))
+        bound.update(bsym.kwargs)
+        eps = bound.get("eps")
+        dx, dw = rms_norm_bwd(g, bound["a"], bound.get("weight"),
+                              1e-6 if eps is None else float(pyval(eps)))
+        grads = [None] * len(bsym.args)
+        grads[0] = dx
+        if bound.get("weight") is not None and len(bsym.args) >= 3:
+            grads[2] = dw
+        return grads
 
     @register_vjp("torch.apply_rope")
     def _rope_vjp(bsym, g):
