@@ -31,6 +31,10 @@ def main() -> None:
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--forward-only", action="store_true")
+    p.add_argument("--optimizer", default="adamw", choices=("adamw", "sgd"))
+    p.add_argument("--executors", default="",
+                   help="comma list, e.g. quant,flash,pallas,jax (TE-seat "
+                        "quantized-training evidence runs)")
     args = p.parse_args()
 
     from thunder_tpu.benchmarks import (
@@ -84,13 +88,18 @@ def main() -> None:
         from thunder_tpu.parallel.sharding import gpt_param_specs
 
         specs = gpt_param_specs(cfg, mesh) if mesh is not None else None
+        ex_list = [e for e in args.executors.split(",") if e] or None
         step, opt = build_train_step(
-            cfg, params, idx, tgt, mesh=mesh, param_specs=specs, lr=args.lr, donate=False
+            cfg, params, idx, tgt, mesh=mesh, param_specs=specs, lr=args.lr,
+            donate=(args.optimizer == "sgd"), grads_in_f32=(args.optimizer != "sgd"),
+            executors=ex_list, optimizer=args.optimizer,
         )
         state = {"params": params, "opt": opt}
+        losses = []
 
         def one_step():
             state["params"], state["opt"], loss = step(state["params"], state["opt"], idx, tgt)
+            losses.append(loss)
             return loss
 
         result = run_benchmark(
@@ -99,6 +108,11 @@ def main() -> None:
         )
 
     summary = result.summary()
+    if not args.forward_only:
+        summary["loss_first"] = round(float(np.asarray(losses[0])), 4)
+        summary["loss_last"] = round(float(np.asarray(losses[-1])), 4)
+        if args.executors:
+            summary["executors"] = args.executors
     summary["n_params"] = n_params
     summary["mesh"] = {"dp": args.dp, "fsdp": args.fsdp, "tp": args.tp}
     print(json.dumps(summary))

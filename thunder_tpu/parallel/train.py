@@ -68,20 +68,23 @@ def adamw_update(params, grads, state, *, lr=1e-3, b1=0.9, b2=0.95, eps=1e-8, we
 # =============================================================================
 
 
-def _compile_loss_and_grads(config: GPTConfig, params, idx, targets):
+def _compile_loss_and_grads(config: GPTConfig, params, idx, targets, executors=None):
     """Trace loss_fn through the framework pipeline → a pure jax callable
     taking the flat tensor leaves and returning (loss, grads_tuple)."""
     from thunder_tpu.api import trace_program
     from thunder_tpu.executors.passes import transform_for_execution
     from thunder_tpu.extend import resolve_executors
+    from thunder_tpu.transforms.attention_residuals import save_sdpa_residuals_joint
     from thunder_tpu.transforms.autodiff import grad_transform
     from thunder_tpu.transforms.common import dce
 
+    ex_list = resolve_executors(executors)
     fn = lambda p, i, t: loss_fn(p, i, t, config)  # noqa: E731
     _, comp = trace_program(fn, (params, idx, targets), {})
     comp = dce(comp)
     joint = grad_transform(comp, return_value=True)
-    extrace = transform_for_execution(joint, resolve_executors(None))
+    joint = save_sdpa_residuals_joint(joint, ex_list)
+    extrace = transform_for_execution(joint, ex_list)
     return extrace.python_callable(), extrace
 
 
@@ -100,6 +103,8 @@ def build_train_step(
     b2: float = 0.95,
     grads_in_f32: bool = True,
     donate: bool = True,
+    executors=None,
+    optimizer: str = "adamw",
 ):
     """Compile one full training step (fw+bw+AdamW) as a single sharded XLA
     executable. Returns ``(step_fn, opt_state)``;
@@ -109,7 +114,7 @@ def build_train_step(
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec
 
-    loss_and_grads, _ = _compile_loss_and_grads(config, params, idx, targets)
+    loss_and_grads, _ = _compile_loss_and_grads(config, params, idx, targets, executors=executors)
 
     def step(params, opt_state, idx, targets):
         flat, _ = tree_flatten(((params, idx, targets), {}))
@@ -118,12 +123,20 @@ def build_train_step(
             grads = tuple(g.astype(jnp.float32) for g in grads)
         p_flat, p_spec = tree_flatten(params)
         grads_tree = tree_unflatten(p_spec, list(grads))
+        if optimizer == "sgd":
+            # bf16-true SGD(wd) — no moment state; what lets multi-GB models
+            # train on one 16 GB chip (the bench.py protocol)
+            new_params = tree_map(
+                lambda p, g: (p - lr * (g.astype(p.dtype) + weight_decay * p)).astype(p.dtype),
+                params, grads_tree,
+            )
+            return new_params, opt_state, loss
         new_params, new_state = adamw_update(
             params, grads_tree, opt_state, lr=lr, b1=b1, b2=b2, weight_decay=weight_decay
         )
         return new_params, new_state, loss
 
-    opt_state = adamw_init(params)
+    opt_state = adamw_init(params) if optimizer != "sgd" else {"step": 0}
 
     if mesh is None:
         jfn = jax.jit(step, donate_argnums=(0, 1) if donate else ())
