@@ -181,7 +181,7 @@ ex.register_implementation("torch.cross_entropy_bwd", fn=_ce_bwd_impl, checker=_
 # the same kernel with -sin (see the torch.apply_rope VJP rule).
 
 
-_ROPE_BT = 256  # sequence rows per block
+_ROPE_BT = 2048  # sequence rows per block
 
 
 def _rope_checker(x, cos, sin):
