@@ -485,3 +485,24 @@ class TestNormExecutor:
         np.testing.assert_allclose(float(lf), float(ls), rtol=2e-2)
         np.testing.assert_allclose(_f32(gf[0]), _f32(gs[0]), rtol=5e-2, atol=5e-2)
         np.testing.assert_allclose(_f32(gf[1]), _f32(gs[1]), rtol=5e-2, atol=5e-1)
+
+    def test_layer_norm_opt_in(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(16, 256).astype(np.float32), dtype=jnp.bfloat16)
+        w = jnp.asarray((rng.randn(256) * 0.1 + 1.0).astype(np.float32), dtype=jnp.bfloat16)
+        b = jnp.asarray((rng.randn(256) * 0.1).astype(np.float32), dtype=jnp.bfloat16)
+
+        def loss(x, w, b):
+            return ttorch.sum(ttorch.layer_norm(x, (256,), w, b, eps=1e-5).float() ** 2)
+
+        vgf = thunder_tpu.value_and_grad(loss, executors=["norm", "jax"])
+        vgs = thunder_tpu.value_and_grad(loss, executors=jax_only)
+        lf, gf = vgf(x, w, b)
+        ls, gs = vgs(x, w, b)
+        src = thunder_tpu.last_traces(vgf)[-1].python()
+        assert "norm_layer_norm" in src and "norm_layer_norm_bwd" in src
+        np.testing.assert_allclose(float(lf), float(ls), rtol=2e-2)
+        for n, a, bb in zip(["dx", "dw", "db"], gf, gs):
+            np.testing.assert_allclose(_f32(a), _f32(bb), rtol=5e-2, atol=5e-1, err_msg=n)

@@ -374,7 +374,121 @@ def _rms_bwd_impl(g, a, weight, eps):
     return dx.reshape(a.shape), dw
 
 
+def _ln_fwd_checker(a, normalized_shape, weight=None, bias=None, eps=1e-5):
+    return len(tuple(normalized_shape)) == 1 and _rms_shapes_ok(a, weight)
+
+
+def _ln_bwd_checker(g, a, weight, bias, eps):
+    return _rms_shapes_ok(a, weight)
+
+
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, out_ref, *, eps: float, has_bias: bool):
+    import jax
+    import jax.numpy as jnp
+
+    x = x_ref[...].astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    y = xc * jax.lax.rsqrt(var + eps) * w_ref[...].astype(jnp.float32)
+    if has_bias:
+        y = y + b_ref[...].astype(jnp.float32)
+    out_ref[...] = y.astype(out_ref.dtype)
+
+
+def _ln_bwd_kernel(g_ref, x_ref, w_ref, dx_ref, dwp_ref, dbp_ref, *, eps: float):
+    import jax
+    import jax.numpy as jnp
+
+    x = x_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    wg = g * w
+    m1 = jnp.mean(wg, axis=-1, keepdims=True)
+    m2 = jnp.mean(wg * xhat, axis=-1, keepdims=True)
+    dx_ref[...] = (rstd * (wg - m1 - xhat * m2)).astype(dx_ref.dtype)
+    rows = jax.lax.broadcasted_iota(jnp.int32, dwp_ref.shape, dimension=0)
+    dwp_ref[...] = jnp.where(rows == 0, jnp.sum(g * xhat, axis=0, keepdims=True), 0.0)
+    dbp_ref[...] = jnp.where(rows == 0, jnp.sum(g, axis=0, keepdims=True), 0.0)
+
+
+def _ln_impl(a, normalized_shape, weight=None, bias=None, eps=1e-5):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    e = float(eps)
+    D = a.shape[-1]
+    xf = a.reshape(-1, D)
+    N = xf.shape[0]
+    bt = _norm_bt(N, D)
+    w2 = weight.reshape(1, D)
+    has_bias = bias is not None
+    b2 = bias.reshape(1, D) if has_bias else jnp.zeros((1, D), dtype=a.dtype)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            partial(_ln_fwd_kernel, eps=e, has_bias=has_bias),
+            grid=(N // bt,),
+            in_specs=[
+                pl.BlockSpec((bt, D), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, D), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, D), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((bt, D), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((N, D), a.dtype),
+            interpret=_interpret(),
+        )(xf, w2, b2)
+    return out.reshape(a.shape)
+
+
+def _ln_bwd_impl(g, a, weight, bias, eps):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    e = float(eps)
+    D = a.shape[-1]
+    xf = a.reshape(-1, D)
+    gf = g.reshape(-1, D)
+    N = xf.shape[0]
+    bt = _norm_bt(N, D)
+    w2 = weight.reshape(1, D)
+    with jax.enable_x64(False):
+        dx, dwp, dbp = pl.pallas_call(
+            partial(_ln_bwd_kernel, eps=e),
+            grid=(N // bt,),
+            in_specs=[
+                pl.BlockSpec((bt, D), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((bt, D), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, D), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((bt, D), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((8, D), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((8, D), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((N, D), a.dtype),
+                jax.ShapeDtypeStruct((8 * (N // bt), D), jnp.float32),
+                jax.ShapeDtypeStruct((8 * (N // bt), D), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(gf, xf, w2)
+    dw = jnp.sum(dwp, axis=0).astype(weight.dtype)
+    db = jnp.sum(dbp, axis=0).astype(weight.dtype) if bias is not None else None
+    return dx.reshape(a.shape), dw, db
+
+
 norm_ex = OperatorExecutor("norm")
 register_executor(norm_ex)
 norm_ex.register_implementation("torch.rms_norm", fn=_rms_impl, checker=_rms_fwd_checker)
 norm_ex.register_implementation("torch.rms_norm_bwd", fn=_rms_bwd_impl, checker=_rms_bwd_checker)
+norm_ex.register_implementation("torch.layer_norm", fn=_ln_impl, checker=_ln_fwd_checker)
+norm_ex.register_implementation("torch.layer_norm_bwd", fn=_ln_bwd_impl, checker=_ln_bwd_checker)

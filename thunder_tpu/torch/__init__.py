@@ -1246,6 +1246,35 @@ def sdpa_bwd(g, query, key, value, attn_mask=None, is_causal: bool = False,
     return dq, dk, dv
 
 
+@torchsymbol(id="torch.layer_norm_bwd")
+def layer_norm_bwd(g, a, weight, bias, eps: float):
+    """(dx, dw, db) of last-dim LayerNorm — composite for the fused-norm
+    executor (reference seat: cudnn_layernormex.py:134)."""
+    compute_dtype = dtypes.float32 if a.dtype in (dtypes.bfloat16, dtypes.float16) else a.dtype
+    xf = clang.maybe_convert_to_dtype(a, compute_dtype)
+    gf = clang.maybe_convert_to_dtype(g, compute_dtype)
+    v, mu = clang.var_mean(xf, (-1,), correction=0, keepdim=True)
+    rstd = clang.rsqrt(clang.add(v, eps))
+    xhat = clang.mul(clang.sub(xf, mu), rstd)
+    wg = gf if weight is None else clang.mul(gf, clang.maybe_convert_to_dtype(weight, compute_dtype))
+    m1 = clang.mean(wg, (-1,), True)
+    m2 = clang.mean(clang.mul(wg, xhat), (-1,), True)
+    dx = clang.mul(rstd, clang.sub(clang.sub(wg, m1), clang.mul(xhat, m2)))
+    dx = clang.maybe_convert_to_dtype(dx, a.dtype)
+    red_dims = tuple(range(a.ndim - 1))
+    dw = db = None
+    if weight is not None:
+        dw = clang.maybe_convert_to_dtype(
+            clang.sum(clang.mul(gf, xhat), red_dims) if red_dims else clang.mul(gf, xhat),
+            weight.dtype,
+        )
+    if bias is not None:
+        db = clang.maybe_convert_to_dtype(
+            clang.sum(gf, red_dims) if red_dims else gf, bias.dtype
+        )
+    return dx, dw, db
+
+
 @torchsymbol(id="torch.rms_norm_bwd")
 def rms_norm_bwd(g, a, weight, eps: float):
     """(dx, dw) of last-dim RMSNorm — kept composite so the Pallas fused
@@ -1456,6 +1485,24 @@ def _register_composite_vjps():
 
     def _rms_checker(a, normalized_shape, weight=None, eps=None):
         return len(tuple(normalized_shape)) == 1  # last-dim norm only
+
+    def _ln_checker(a, normalized_shape, weight=None, bias=None, eps=1e-5):
+        return len(tuple(normalized_shape)) == 1
+
+    @register_vjp("torch.layer_norm", checker=_ln_checker)
+    def _layer_norm_vjp(bsym, g):
+        bound = dict(zip(("a", "normalized_shape", "weight", "bias", "eps"), bsym.args))
+        bound.update(bsym.kwargs)
+        eps = bound.get("eps", 1e-5)
+        dx, dw, db = layer_norm_bwd(g, bound["a"], bound.get("weight"), bound.get("bias"),
+                                    float(pyval(eps)))
+        grads = [None] * len(bsym.args)
+        grads[0] = dx
+        if bound.get("weight") is not None and len(bsym.args) >= 3:
+            grads[2] = dw
+        if bound.get("bias") is not None and len(bsym.args) >= 4:
+            grads[3] = db
+        return grads
 
     @register_vjp("torch.rms_norm", checker=_rms_checker)
     def _rms_norm_vjp(bsym, g):
