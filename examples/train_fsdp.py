@@ -1,0 +1,118 @@
+"""Sharded pretraining example (reference: examples/lit-gpt/train_fsdp.py).
+
+Where the reference wraps the model in torch FSDP and lets NCCL shard
+params/grads, the thunder_tpu way is a device mesh + PartitionSpecs: params
+are dim-0 sharded over the ``fsdp`` axis (and optionally Megatron-split over
+``tp``), the batch is split over ``dp``×``fsdp``, and XLA's SPMD partitioner
+inserts and schedules every collective. Optimizer state inherits the param
+specs — ZeRO-sharded AdamW for free.
+
+Run on real hardware (mesh axes = however many chips you have):
+    python examples/train_fsdp.py --mesh fsdp=8
+    python examples/train_fsdp.py --mesh dp=2,fsdp=2,tp=2 --model llama-2-7b
+
+Run anywhere (8 virtual CPU devices — what the smoke test does):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/train_fsdp.py --mesh fsdp=8 --model llama-tiny --iters 4
+
+Multi-host: launch one process per host with the usual JAX env
+(``thunder_tpu.distributed.init()`` wires jax.distributed); the mesh then
+spans all hosts and the same script runs unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_mesh(spec: str) -> dict:
+    axes = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        axes[name.strip()] = int(size)
+    return axes
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="pythia-160m")
+    p.add_argument("--mesh", default="fsdp=8", help='e.g. "fsdp=8" or "dp=2,fsdp=2,tp=2"')
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--global-batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=None)
+    p.add_argument("--optimizer", choices=("sgd", "adamw"), default="adamw")
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--weight-decay", type=float, default=0.1)
+    p.add_argument("--seed", type=int, default=42)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+
+    from thunder_tpu.api import _ensure_runtime
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.models import gpt
+    from thunder_tpu.parallel import (
+        build_train_step,
+        gpt_param_specs,
+        make_mesh,
+        shard_pytree,
+    )
+
+    _ensure_runtime()
+    config = gpt.name_to_config(args.model)
+    seq = args.seq_len or config.block_size
+    mesh = make_mesh(**parse_mesh(args.mesh))
+    print(f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))} model={args.model} "
+          f"B={args.global_batch_size} T={seq}", file=sys.stderr)
+
+    # Init on host, then lay params out over the mesh per the sharding plan.
+    params = gpt.init_params(config, dtype=dtypes.bfloat16, seed=args.seed)
+    specs = gpt_param_specs(config, mesh)
+    params = shard_pytree(params, mesh, specs)
+
+    rng = np.random.RandomState(args.seed)
+
+    def batch():
+        idx = rng.randint(0, config.vocab_size, (args.global_batch_size, seq)).astype(np.int32)
+        return idx, np.roll(idx, -1, axis=1).astype(np.int32)
+
+    idx, tgt = batch()
+    t0 = time.perf_counter()
+    step, opt_state = build_train_step(
+        config, params, idx, tgt,
+        mesh=mesh, param_specs=specs,
+        lr=args.lr, weight_decay=args.weight_decay, optimizer=args.optimizer,
+    )
+    params, opt_state, loss = step(params, opt_state, idx, tgt)
+    print(f"trace+compile+first-step: {time.perf_counter() - t0:.1f}s "
+          f"loss={float(np.asarray(loss)):.4f}", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    prev = None
+    for i in range(args.iters):
+        idx, tgt = batch()
+        params, opt_state, loss = step(params, opt_state, idx, tgt)
+        if prev is not None:
+            print(f"iter {i - 1}: loss {float(np.asarray(prev)):.4f}", file=sys.stderr)
+        prev = loss
+    final = float(np.asarray(prev))
+    total = time.perf_counter() - t0
+    print(f"iter {args.iters - 1}: loss {final:.4f}", file=sys.stderr)
+
+    tokens = args.global_batch_size * seq
+    print(f"{args.iters} iters: {total:.2f}s  avg {total / args.iters:.4f}s/iter  "
+          f"{tokens * args.iters / total:,.0f} tok/s")
+    assert np.isfinite(final), "loss diverged"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Long-horizon quantized-training evidence (VERDICT r4 #6).
+
+Trains pythia-160m for N iterations three ways — bf16 baseline, int8
+everywhere, and int8 with the lm_head excluded (the TE skip_modules recipe,
+reference: transformer_engineex.py:398-437) — on the SAME synthetic data
+stream, and writes the loss curves + timing to a JSON file for PARITY.md.
+
+Usage: python scripts/quant_convergence.py [iters] [out.json]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+MODEL = "pythia-160m"
+B, T = 4, 1024
+ITERS = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+OUT = sys.argv[2] if len(sys.argv) > 2 else "/tmp/quant_convergence.json"
+LR, WD = 3e-4, 0.1
+
+
+def run(tag: str, executors, skip_out=()):
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.executors.quantex import QuantRecipe, set_recipe
+    from thunder_tpu.models import gpt
+    from thunder_tpu.parallel import build_train_step
+
+    set_recipe(QuantRecipe(skip_out_features=tuple(skip_out)))
+    cfg = gpt.name_to_config(MODEL)
+    params = gpt.init_params(cfg, dtype=dtypes.bfloat16, device_init=True, seed=0)
+    rng = np.random.RandomState(0)  # identical stream for every variant
+
+    idx = rng.randint(0, cfg.vocab_size, (B, T)).astype(np.int32)
+    tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+    step, opt = build_train_step(
+        cfg, params, idx, tgt, lr=LR, weight_decay=WD, optimizer="adamw",
+        executors=executors,
+    )
+    params, opt, loss = step(params, opt, idx, tgt)
+    losses = [float(np.asarray(loss))]
+
+    t0 = time.perf_counter()
+    prev = None
+    for i in range(ITERS - 1):
+        idx = rng.randint(0, cfg.vocab_size, (B, T)).astype(np.int32)
+        tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+        params, opt, loss = step(params, opt, idx, tgt)
+        if prev is not None:
+            losses.append(float(np.asarray(prev)))
+        prev = loss
+    losses.append(float(np.asarray(prev)))
+    dt = time.perf_counter() - t0
+    set_recipe(QuantRecipe())  # restore default
+    print(f"# {tag}: {ITERS} iters {dt:.1f}s avg {dt / max(ITERS - 1, 1):.4f}s/iter "
+          f"loss {losses[0]:.3f}->{losses[-1]:.3f}", file=sys.stderr)
+    return {"losses": losses, "iters": ITERS, "avg_iter_s": round(dt / max(ITERS - 1, 1), 4)}
+
+
+def main():
+    from thunder_tpu.api import _ensure_runtime
+    from thunder_tpu.models import gpt
+
+    _ensure_runtime()
+    vocab_padded = gpt.name_to_config(MODEL).padded_vocab_size
+    results = {
+        "model": MODEL, "batch": B, "seq": T,
+        "bf16": run("bf16", None),
+        "int8_all": run("int8_all", ["quant", "pallas", "flash", "jax"]),
+        "int8_skip_lm_head": run(
+            "int8_skip_lm_head", ["quant", "pallas", "flash", "jax"],
+            skip_out=(vocab_padded,),
+        ),
+    }
+    # Convergence-gap summary at a few horizons.
+    for k in ("int8_all", "int8_skip_lm_head"):
+        gaps = {}
+        for h in (10, 50, 100, ITERS):
+            if h <= ITERS:
+                gaps[str(h)] = round(
+                    results[k]["losses"][h - 1] - results["bf16"]["losses"][h - 1], 4
+                )
+        results[k]["loss_gap_vs_bf16"] = gaps
+    with open(OUT, "w") as f:
+        json.dump(results, f)
+    print(json.dumps({k: v for k, v in results.items() if not isinstance(v, dict)} |
+                     {k: {"final_loss": v["losses"][-1], "avg_iter_s": v["avg_iter_s"],
+                          "gap": v.get("loss_gap_vs_bf16")}
+                      for k, v in results.items() if isinstance(v, dict)}))
+
+
+if __name__ == "__main__":
+    main()

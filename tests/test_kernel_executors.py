@@ -167,6 +167,35 @@ class TestFlashMasks:
         want = _f32(thunder_tpu.jit(self._f, executors=jax_only)(q, k, v, m))
         np.testing.assert_allclose(got, want, rtol=2e-2, atol=8e-3)
 
+    def test_bool_keypad_all_masked_row_safe_softmax(self):
+        # ADVICE r4: a batch row whose every key is masked must produce
+        # torch's safe-softmax zeros, not splash's kernel-defined output —
+        # the runtime guard routes it to the exact decomposition.
+        q, k, v = self._qkv()
+        m = np.ones((self.B, 1, 1, self.T), dtype=bool)
+        m[0] = False  # batch 0: no valid key at all
+        jf = thunder_tpu.jit(self._f)
+        got = _f32(jf(q, k, v, m))
+        want = _f32(thunder_tpu.jit(self._f, executors=jax_only)(q, k, v, m))
+        np.testing.assert_allclose(got[0], 0.0, atol=1e-6)
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=8e-3)
+
+    def test_additive_keypad_all_masked_row_shift_invariance(self):
+        # ADVICE r4: an additive row that is uniformly <= -1e9 passes the
+        # 0-or-very-negative check, but softmax shift-invariance means the
+        # exact path attends UNIFORMLY while segment-ids would mask every
+        # key. The non-empty-row guard must force the exact branch.
+        q, k, v = self._qkv()
+        m = np.zeros((self.B, 1, 1, self.T), dtype=np.float32)
+        m[0] = np.finfo(np.float32).min  # whole row "masked"
+        jf = thunder_tpu.jit(self._f)
+        got = _f32(jf(q, k, v, m))
+        want = _f32(thunder_tpu.jit(self._f, executors=jax_only)(q, k, v, m))
+        # batch 0 attends uniformly (mean over values), NOT zeros
+        np.testing.assert_allclose(got[0], _f32(v).mean(axis=-2, keepdims=True)[0]
+                                   * np.ones_like(got[0]), rtol=2e-2, atol=8e-3)
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=8e-3)
+
     def test_additive_bias_falls_back_exactly(self):
         # A real bias (ALiBi-style) fails runtime verification: the cond's
         # decomposed branch must produce the exact decomposition result.

@@ -78,5 +78,6 @@ def test_error_inputs_generated():
             with pytest.raises(ei.ex_type, match=ei.regex) if ei.regex else pytest.raises(ei.ex_type):
                 thunder_tpu.jit(opinfo.op)(*ei.sample.args, **ei.sample.kwargs)
             checked += 1
-    # the table covers the ~30 highest-traffic ops; keep it honest
-    assert checked >= 30, f"only {checked} error inputs ran"
+    # r5: the table + generic broadcast/dim classes cover 100+ invalid calls
+    # across the op surface; keep it honest
+    assert checked >= 100, f"only {checked} error inputs ran"

@@ -164,3 +164,33 @@ class TestQuantizedTraining:
         assert quant[-1] < quant[0] * 0.5, quant
         # and tracks the reference run within a loose band
         assert quant[-1] < bf16[-1] * 1.5 + 0.5, (quant[-1], bf16[-1])
+
+
+class TestSkipRecipe:
+    def test_skip_out_features_excludes_layer(self):
+        """The TE skip_modules seat (reference: transformer_engineex.py
+        skip/exclusion handling): linears whose out dim is listed in the
+        recipe stay full-precision — the standard lm_head exclusion."""
+        from thunder_tpu.executors.quantex import QuantRecipe, get_recipe, set_recipe
+
+        x, w_body, w_head = _t(8, 128), _t(64, 128, seed=1) * 0.1, _t(96, 64, seed=2) * 0.1
+
+        def f(x, wb, wh):
+            h = ttorch.linear(x, wb)
+            return ttorch.linear(h, wh)
+
+        old = get_recipe()
+        try:
+            set_recipe(QuantRecipe(skip_out_features=(96,)))
+            qf = thunder_tpu.jit(f, executors=resolve_executors(["quant", "jax"]))
+            qf(x, w_body, w_head)
+            src = thunder_tpu.last_traces(qf)[-1].python()
+            # body linear (out=64) claimed; head linear (out=96) NOT
+            assert src.count("quant_linear") == 1, src
+        finally:
+            set_recipe(old)
+
+    def test_default_recipe_skips_nothing(self):
+        from thunder_tpu.executors.quantex import get_recipe
+
+        assert get_recipe().skip_out_features == ()

@@ -196,14 +196,107 @@ def _build_prologue(
     return plg
 
 
-def trace_program(fn: Callable, args: tuple, kwargs: dict) -> tuple[TraceCtx, TraceCtx]:
-    """Acquire ``fn`` as (prologue_trace, computation_trace)."""
+def _copy_container_tree(tree: Any) -> Any:
+    """Structural copy (fresh containers, shared leaf proxies) — the pristine
+    baseline for input-mutation detection."""
+    if isinstance(tree, (tuple, list)):
+        return type(tree)(_copy_container_tree(v) for v in tree)
+    if isinstance(tree, dict):
+        return {k: _copy_container_tree(v) for k, v in tree.items()}
+    return tree
+
+
+_MISSING = object()
+
+
+def _mutation_value_spec(v: Any, extras: list):
+    """Encode a mutated-in value: trace proxies become extra computation
+    outputs (("out", j)); plain Python data is stored inline."""
+    from thunder_tpu.core.proxies import pyval
+    from thunder_tpu.core.symbol import resolve_inplace
+
+    if isinstance(v, TensorProxy):
+        extras.append(resolve_inplace(v))
+        return ("out", len(extras) - 1)
+    if isinstance(v, NumberProxy):
+        return ("const", pyval(v))
+    if isinstance(v, dict):
+        return ("dict", {k: _mutation_value_spec(x, extras) for k, x in v.items()})
+    if isinstance(v, (list, tuple)):
+        tag = "list" if isinstance(v, list) else "tuple"
+        return (tag, [_mutation_value_spec(x, extras) for x in v])
+    return ("const", v)
+
+
+def _diff_container_tree(cur: Any, orig: Any, path: tuple, muts: list, extras: list) -> None:
+    """Record container mutations fn made to its (proxied) inputs.
+
+    Reference parity: thunder/core/jit_ext.py `process_recorded_modifications
+    :1302` — the VM records STORE_SUBSCR et al.; here the proxied containers
+    are diffed against a pristine structural copy after tracing."""
+    if isinstance(orig, dict) and isinstance(cur, dict):
+        for k in orig:
+            if k not in cur:
+                muts.append(("del", path, k))
+        for k, v in cur.items():
+            ov = orig.get(k, _MISSING)
+            if ov is _MISSING or ov is not v:
+                muts.append(("set", path, k, _mutation_value_spec(v, extras)))
+            else:
+                _diff_container_tree(v, ov, path + (k,), muts, extras)
+    elif isinstance(orig, list) and isinstance(cur, list):
+        if len(cur) != len(orig) or any(a is not b for a, b in zip(cur, orig)):
+            muts.append(("resync", path, [_mutation_value_spec(v, extras) for v in cur]))
+        else:
+            for i, (a, b) in enumerate(zip(cur, orig)):
+                _diff_container_tree(a, b, path + (i,), muts, extras)
+    elif isinstance(orig, tuple) and isinstance(cur, tuple) and len(orig) == len(cur):
+        for i, (a, b) in enumerate(zip(cur, orig)):
+            _diff_container_tree(a, b, path + (i,), muts, extras)
+
+
+def _collect_input_mutations(
+    proxied_args, proxied_kwargs, pristine_args, pristine_kwargs, tensor_leaves
+) -> tuple[list, list]:
+    """(mutation records, extra output proxies) for epilogue replay.
+
+    Two classes (reference: jit_ext.py:1302 + the input-mutation sharp edge
+    at jit_ext.py:468): container mutations (``d["k"] = t``) and in-place
+    tensor updates on INPUT tensors (``x.add_(1)``)."""
+    from thunder_tpu.core.symbol import resolve_inplace
+
+    muts: list = []
+    extras: list = []
+    _diff_container_tree(proxied_args, pristine_args, ("args",), muts, extras)
+    _diff_container_tree(proxied_kwargs, pristine_kwargs, ("kwargs",), muts, extras)
+    for i, p in enumerate(tensor_leaves):
+        fp = resolve_inplace(p)
+        if fp is not p:
+            extras.append(fp)
+            muts.append(("tensor", i, ("out", len(extras) - 1)))
+    return muts, extras
+
+
+def trace_program(
+    fn: Callable, args: tuple, kwargs: dict, *, record_input_mutations: bool = False
+) -> tuple[TraceCtx, TraceCtx]:
+    """Acquire ``fn`` as (prologue_trace, computation_trace).
+
+    With ``record_input_mutations`` (the jit() path), mutations fn makes to
+    its inputs (container writes, in-place tensor updates) are detected
+    post-trace and recorded on ``comp_trc._input_mutations``; the
+    computation output is then wrapped as ``{"__out": ..., "__muts": (...)}``
+    so the staged program computes the final values and the caller replays
+    them (CacheEntry.epilogue_fn). The module frontend has its own epilogue
+    (frontend/module.py) and keeps this off."""
     comp_trc = TraceCtx(fn)
     comp_trc.name = "computation"
 
     with tracectx(comp_trc):
         proxied_args = _proxify_tree(args, comp_trc)
         proxied_kwargs = _proxify_tree(kwargs, comp_trc)
+    pristine_args = _copy_container_tree(proxied_args)
+    pristine_kwargs = _copy_container_tree(proxied_kwargs)
 
     # Canonical leaf order = jax.tree_util flatten order (sorted dict keys),
     # so grads, prologue outputs, and computation args all align with what
@@ -232,10 +325,30 @@ def trace_program(fn: Callable, args: tuple, kwargs: dict) -> tuple[TraceCtx, Tr
             from thunder_tpu.core.symbol import resolve_inplace_tree
 
             result = resolve_inplace_tree(result)
+
+        muts: list = []
+        extras: list = []
+        if record_input_mutations:
+            muts, extras = _collect_input_mutations(
+                proxied_args, proxied_kwargs, pristine_args, pristine_kwargs, tensor_leaves
+            )
+        comp_trc._input_mutations = muts
+        if muts:
+            from thunder_tpu.common import sharp_edge
+
+            kinds = sorted({m[0] for m in muts})
+            sharp_edge(
+                f"traced function mutates its inputs ({', '.join(kinds)}): the "
+                "final values are replayed onto the caller's objects after "
+                "execution (epilogue)"
+            )
+            result = {"__out": result, "__muts": tuple(extras)}
         prims.python_return(result)
     comp_trc.output = result
 
-    plg = _build_prologue(args, kwargs, proxied_args, proxied_kwargs, tensor_leaves)
+    # The prologue guards/unpacks the CALLER's structure — build it from the
+    # pristine copies so fn's container mutations can't skew the guards.
+    plg = _build_prologue(args, kwargs, pristine_args, pristine_kwargs, tensor_leaves)
     # Concretization is only possible while the user function executes; drop
     # the concrete-input references so cached trace objects don't pin the
     # first call's tensors (and params) for the process lifetime.
@@ -265,8 +378,16 @@ def _compile_entry(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict)
 
     cs.last_trace_tracing_start = timer_ns()
     with sharp_edges_policy(cd.sharp_edges):
-        plg_trc, comp_trc = trace_program(cd.fn, args, kwargs)
+        plg_trc, comp_trc = trace_program(cd.fn, args, kwargs, record_input_mutations=True)
     cs.last_trace_tracing_stop = timer_ns()
+
+    input_mutations = getattr(comp_trc, "_input_mutations", None) or []
+    if input_mutations and cd.compile_options.get("_trace_transforms"):
+        raise NotImplementedError(
+            "the traced function mutates its inputs, which cannot be combined "
+            "with trace transforms (grad/value_and_grad/autocast) — make the "
+            "function pure or apply updates outside it"
+        )
 
     from thunder_tpu.core.concrete import value_guards_of
 
@@ -343,7 +464,7 @@ def _compile_entry(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict)
     entry = CacheEntry(
         prologue_fn=prologue_fn,
         computation_fn=computation_fn,
-        epilogue_fn=None,
+        epilogue_fn=_build_epilogue(input_mutations) if input_mutations else None,
         backward_fn=None,
         prologue_traces=plg_traces,
         computation_traces=computation_traces,
@@ -391,6 +512,75 @@ def _next_key():
 
     _global_rng["seed"] += 1
     return jax.random.PRNGKey(_global_rng["seed"])
+
+
+def _build_epilogue(muts: list) -> Callable:
+    """Side-effect replay for input-mutating traced functions (reference:
+    jit_ext.py `process_recorded_modifications:1302`).
+
+    Called per execution with the caller's (args, kwargs), the prologue's
+    flat tensor leaves, and the raw {"__out", "__muts"} computation output;
+    applies each recorded mutation to the CALLER's objects and returns the
+    user-visible output."""
+
+    def navigate(args, kwargs, path):
+        obj = args if path[0] == "args" else kwargs
+        for k in path[1:]:
+            obj = obj[k]
+        return obj
+
+    def build_value(spec, extras):
+        tag, payload = spec
+        if tag == "out":
+            return extras[payload]
+        if tag == "const":
+            return payload
+        if tag == "dict":
+            return {k: build_value(v, extras) for k, v in payload.items()}
+        if tag == "list":
+            return [build_value(v, extras) for v in payload]
+        return tuple(build_value(v, extras) for v in payload)  # "tuple"
+
+    def epilogue(args, kwargs, flat_inps, raw_out):
+        import numpy as np
+
+        extras = raw_out["__muts"]
+        for rec in muts:
+            if rec[0] == "tensor":
+                _, i, spec = rec
+                target = flat_inps[i]
+                val = build_value(spec, extras)
+                if bridge.is_torch_tensor(target):
+                    import torch
+
+                    with torch.no_grad():
+                        target.copy_(bridge.to_torch(val).to(target.dtype))
+                elif isinstance(target, np.ndarray):
+                    np.copyto(target, np.asarray(val).astype(target.dtype, copy=False))
+                else:
+                    # jax.Array inputs are immutable — nothing to write back;
+                    # the functional value is still available via the output.
+                    import warnings
+
+                    warnings.warn(
+                        "in-place update of an immutable (jax) input tensor "
+                        "cannot be replayed onto the caller's array",
+                        stacklevel=3,
+                    )
+            elif rec[0] == "set":
+                _, path, key, spec = rec
+                navigate(args, kwargs, path)[key] = build_value(spec, extras)
+            elif rec[0] == "del":
+                _, path, key = rec
+                container = navigate(args, kwargs, path)
+                container.pop(key, None)
+            else:  # "resync": a list changed length/identity — rebuild it
+                _, path, specs = rec
+                container = navigate(args, kwargs, path)
+                container[:] = [build_value(s, extras) for s in specs]
+        return raw_out["__out"]
+
+    return epilogue
 
 
 def _run_entry(entry: CacheEntry, flat_inps: tuple) -> Any:
@@ -529,6 +719,8 @@ def jit(
             cs.cache_hits += 1
             cs.last_trace_cache_stop = timer_ns()
             result = _run_entry(entry, flat_inps)
+            if entry.epilogue_fn is not None:
+                result = entry.epilogue_fn(args, kwargs, flat_inps, result)
             cs.last_trace_host_stop = timer_ns()
             return result
         cs.last_trace_cache_stop = timer_ns()
@@ -537,6 +729,8 @@ def jit(
         entry = _compile_entry(cd, cs, args, kwargs)
         flat_inps = entry.prologue_fn(*args, **kwargs)
         result = _run_entry(entry, flat_inps)
+        if entry.epilogue_fn is not None:
+            result = entry.epilogue_fn(args, kwargs, flat_inps, result)
         cs.last_trace_host_stop = timer_ns()
         return result
 
@@ -556,9 +750,15 @@ def grad(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
 
     Grads are returned as a tuple ordered like the function's float tensor
     leaves (pytree inputs are flattened in argument order).
-    """
+
+    ``grad(vmap(f))`` composes: the pullback of the batched program is taken
+    with ones cotangents on every output — the reference's value_and_grad
+    semantics for non-scalar outputs (transforms.py:3704 seeds
+    ``ones_like``)."""
     if fn is None:
         return functools.partial(grad, **jit_kwargs)
+    if getattr(fn, "_lc_vmap_spec", None) is not None:
+        return _grad_of_vmapped(fn, return_value=False, jit_kwargs=jit_kwargs)
     from thunder_tpu.transforms.autodiff import grad_transform
 
     return jit(fn, _trace_transforms=(lambda trc: grad_transform(trc, return_value=False),), **jit_kwargs)
@@ -568,9 +768,91 @@ def value_and_grad(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
     """Like :func:`grad` but returns ``(value, grads)``."""
     if fn is None:
         return functools.partial(value_and_grad, **jit_kwargs)
+    if getattr(fn, "_lc_vmap_spec", None) is not None:
+        return _grad_of_vmapped(fn, return_value=True, jit_kwargs=jit_kwargs)
     from thunder_tpu.transforms.autodiff import grad_transform
 
     return jit(fn, _trace_transforms=(lambda trc: grad_transform(trc, return_value=True),), **jit_kwargs)
+
+
+def _grad_of_vmapped(vfn: Callable, *, return_value: bool,
+                     jit_kwargs: Optional[dict] = None) -> Callable:
+    """grad/value_and_grad of a :func:`vmap`-ed function.
+
+    The batched staged program's pullback is evaluated with ones cotangents
+    (reference value_and_grad semantics for non-scalar outputs) w.r.t. the
+    FLOAT tensor leaves, all under one jax.jit. Staging is cached on input
+    metadata like vmap itself. Of jit()'s options only ``executors`` applies
+    on this path (there is no prologue/cache machinery to configure) — any
+    other option is rejected loudly rather than silently dropped."""
+    import jax
+    import jax.numpy as jnp
+
+    jit_kwargs = dict(jit_kwargs or {})
+    user_executors = jit_kwargs.pop("executors", None)
+    if jit_kwargs:
+        raise ValueError(
+            f"grad(vmap(f)) supports only the 'executors' option; got "
+            f"{sorted(jit_kwargs)}"
+        )
+    executor_stacks = (
+        (user_executors, ["jax"]) if user_executors is not None else (None, ["jax"])
+    )
+
+    spec = vfn._lc_vmap_spec
+    inner_fn, inner_tts = _unwrap_compiled(spec["fn"])
+    in_axes, out_axes = spec["in_axes"], spec["out_axes"]
+    cache: dict = {}
+    cs = CompileStats()
+
+    def wrapper(*args, **kwargs):
+        cs.calls += 1
+        axes, flat_axes, flat_args = _vmap_flatten(args, kwargs, in_axes)
+        diff_idx = tuple(
+            i for i, x in enumerate(flat_args) if jnp.issubdtype(x.dtype, jnp.floating)
+        )
+
+        key = _meta_key(
+            tree_flatten((args, kwargs))[0], extra=(tuple(flat_axes), out_axes, return_value)
+        )
+        staged = cache.get(key)
+        if staged is not None:
+            cs.cache_hits += 1
+            result = staged(*flat_args)
+            return result if return_value else result[1]
+        cs.cache_misses += 1
+
+        example = _vmap_example(args, axes)
+        for ex_list in executor_stacks:
+            flat_fn = _staged_flat_fn(
+                inner_fn, example, kwargs, executors=ex_list, trace_transforms=inner_tts
+            )
+            batched = jax.vmap(flat_fn, in_axes=flat_axes, out_axes=out_axes)
+
+            def vg(*flat, _batched=batched):
+                def diff_only(*diff):
+                    full = list(flat)
+                    for i, d in zip(diff_idx, diff):
+                        full[i] = d
+                    return _batched(*full)
+
+                out, pullback = jax.vjp(diff_only, *[flat[i] for i in diff_idx])
+                cts = tree_map(jnp.ones_like, out)
+                grads = pullback(cts)
+                return out, grads
+
+            staged = jax.jit(vg)
+            try:
+                result = staged(*flat_args)
+            except Exception as e:  # noqa: BLE001 — narrowly re-matched below
+                if ex_list is not None or not _is_kernel_transform_error(e):
+                    raise
+                continue
+            cache[key] = staged
+            return result if return_value else result[1]
+
+    wrapper._lc_cs = cs
+    return wrapper
 
 
 # =============================================================================
@@ -581,16 +863,32 @@ def value_and_grad(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
 
 
 def _staged_flat_fn(fn: Callable, args: tuple, kwargs: Optional[dict] = None,
-                    executors: Optional[Sequence] = None) -> Callable:
+                    executors: Optional[Sequence] = None,
+                    trace_transforms: Sequence[Callable] = ()) -> Callable:
     """Trace+claim fn for the given example args → flat jax callable whose
     inputs are the TENSOR leaves of (args, kwargs) in pytree order (number/
-    string leaves are prologue-guarded constants baked into the trace)."""
+    string leaves are prologue-guarded constants baked into the trace).
+    ``trace_transforms`` (e.g. grad_transform) run after dce/cse, mirroring
+    _compile_entry's pipeline — this is what lets vmap compose with a
+    grad-compiled function."""
     from thunder_tpu.executors.passes import transform_for_execution
 
     _, comp = trace_program(fn, args, kwargs or {})
-    comp = dce(comp)
+    comp = cse(dce(comp))
+    for tt in trace_transforms:
+        comp = tt(comp)
     extrace = transform_for_execution(comp, resolve_executors(executors))
     return extrace.python_callable()
+
+
+def _unwrap_compiled(fn: Callable) -> tuple[Callable, tuple]:
+    """(inner_fn, trace_transforms) for a thunder-compiled function —
+    lets vmap/jvp re-stage the ORIGINAL function with its transforms
+    (grad, autocast) instead of tracing through the compiled wrapper."""
+    cd = getattr(fn, "_lc_cd", None)
+    if cd is not None:
+        return cd.fn, tuple(cd.compile_options.get("_trace_transforms", ()))
+    return fn, ()
 
 
 def _is_kernel_transform_error(e: BaseException) -> bool:
@@ -620,6 +918,51 @@ def _meta_key(flat_values, extra=()) -> tuple:
     return tuple(parts) + tuple(extra)
 
 
+def _vmap_flatten(args: tuple, kwargs: dict, in_axes):
+    """Normalize per-arg axes and flatten to (axes, flat_axes, flat_args):
+    tensor leaves only, kwargs leaves unbatched — the one flattening
+    protocol shared by vmap and grad-of-vmap."""
+    if isinstance(in_axes, (tuple, list)):
+        check(
+            len(in_axes) == len(args),
+            lambda: f"vmap in_axes has {len(in_axes)} entries but the call has "
+                    f"{len(args)} positional arguments",
+            ValueError,
+        )
+        axes = tuple(in_axes)
+    else:
+        axes = (in_axes,) * len(args)
+
+    flat_axes: list = []
+    flat_args: list = []
+    for a, ax in zip(args, axes):
+        for x in tree_flatten(a)[0]:
+            if bridge.is_concrete_tensor(x):
+                flat_axes.append(ax)
+                flat_args.append(bridge.to_jax(x))
+    for x in tree_flatten(kwargs)[0]:
+        if bridge.is_concrete_tensor(x):
+            flat_axes.append(None)
+            flat_args.append(bridge.to_jax(x))
+    return axes, flat_axes, flat_args
+
+
+def _vmap_example(args: tuple, axes: tuple) -> tuple:
+    """Slice axis-0 (per the in_axes) off every batched tensor leaf — the
+    one-slice example the staged trace is acquired on."""
+
+    def slice_ax(x, ax):
+        if ax is None or not hasattr(x, "shape"):
+            return x
+        import numpy as np
+
+        return np.asarray(x).take(0, axis=ax)
+
+    return tuple(
+        tree_map(lambda x, _ax=ax: slice_ax(x, _ax), a) for a, ax in zip(args, axes)
+    )
+
+
 def vmap(fn: Callable, in_axes=0, out_axes=0) -> Callable:
     """Vectorizing map over the traced program (experimental; reference
     transforms.py `vmap:2051` is experimental too).
@@ -630,38 +973,22 @@ def vmap(fn: Callable, in_axes=0, out_axes=0) -> Callable:
     with the jax executor only. kwargs are passed through unbatched.
 
     Staging is cached on input metadata (shapes/dtypes/axes): repeat calls
-    do zero tracing (observable via ``compile_stats(vmapped)``)."""
+    do zero tracing (observable via ``compile_stats(vmapped)``).
+
+    Composes with :func:`grad`/:func:`value_and_grad`: ``vmap(grad(f))``
+    re-stages the ORIGINAL f with its grad transform and batches the staged
+    gradient program (per-sample gradients, reference: transforms.py:2051)."""
     import jax
 
+    inner_fn, inner_tts = _unwrap_compiled(fn)
     cache: dict = {}
     cs = CompileStats()
 
     def vmapped(*args, **kwargs):
         cs.calls += 1
-        if isinstance(in_axes, (tuple, list)):
-            check(
-                len(in_axes) == len(args),
-                lambda: f"vmap in_axes has {len(in_axes)} entries but the call has "
-                        f"{len(args)} positional arguments",
-                ValueError,
-            )
-            axes = tuple(in_axes)
-        else:
-            axes = (in_axes,) * len(args)
-
         # The staged computation's inputs are the TENSOR leaves only (number/
         # string leaves are prologue-guarded constants baked into the trace).
-        flat_axes = []
-        flat_args = []
-        for a, ax in zip(args, axes):
-            for x in tree_flatten(a)[0]:
-                if bridge.is_concrete_tensor(x):
-                    flat_axes.append(ax)
-                    flat_args.append(bridge.to_jax(x))
-        for x in tree_flatten(kwargs)[0]:
-            if bridge.is_concrete_tensor(x):
-                flat_axes.append(None)
-                flat_args.append(bridge.to_jax(x))
+        axes, flat_axes, flat_args = _vmap_flatten(args, kwargs, in_axes)
 
         # The key must cover EVERY leaf (scalars included): non-tensor leaves
         # are baked into the staged trace as constants, so a changed scalar
@@ -677,19 +1004,12 @@ def vmap(fn: Callable, in_axes=0, out_axes=0) -> Callable:
 
         # Trace on one slice; batch the staged function. Per-arg in_axes
         # apply to every tensor leaf of that arg (pytree args included).
-        def slice_ax(x, ax):
-            if ax is None or not hasattr(x, "shape"):
-                return x
-            import numpy as np
-
-            return np.asarray(x).take(0, axis=ax)
-
-        example = tuple(
-            tree_map(lambda x, _ax=ax: slice_ax(x, _ax), a) for a, ax in zip(args, axes)
-        )
+        example = _vmap_example(args, axes)
         cs.last_trace_tracing_start = timer_ns()
         for ex_list in (None, ["jax"]):
-            flat_fn = _staged_flat_fn(fn, example, kwargs, executors=ex_list)
+            flat_fn = _staged_flat_fn(
+                inner_fn, example, kwargs, executors=ex_list, trace_transforms=inner_tts
+            )
             batched = jax.jit(jax.vmap(flat_fn, in_axes=flat_axes, out_axes=out_axes))
             try:
                 result = batched(*flat_args)
@@ -704,10 +1024,71 @@ def vmap(fn: Callable, in_axes=0, out_axes=0) -> Callable:
             return result
 
     vmapped._lc_cs = cs
+    vmapped._lc_vmap_spec = {"fn": fn, "in_axes": in_axes, "out_axes": out_axes}
     return vmapped
 
 
-_jvp_cache: dict = {}
+class _JvpCache:
+    """Staged-jvp cache keyed on a WEAKREF to the function, not ``id(fn)``.
+
+    ``id(fn)`` aliases after GC — a new closure at a reused address would
+    silently receive a dead function's staged callable (ADVICE r4). A
+    weakref key can't alias (entries are purged the moment the function
+    dies) and holds no reference to the closure or anything it captures
+    (the cached staged callable is built from the trace, not from ``fn``).
+    Non-weakrefable callables fall back to a strong key (bounded by the
+    LRU); unhashable callables simply skip caching. Eviction is LRU, not
+    the previous clear-all."""
+
+    MAX_ENTRIES = 256
+
+    def __init__(self):
+        from collections import OrderedDict
+
+        self._entries = OrderedDict()
+
+    def _purge(self, dead_ref) -> None:
+        for k in [k for k in self._entries if k[0] is dead_ref]:
+            del self._entries[k]
+
+    def get(self, fn, key):
+        import weakref
+
+        try:
+            ref = weakref.ref(fn)
+        except TypeError:
+            ref = fn
+        try:
+            value = self._entries.get((ref, key))
+        except TypeError:  # unhashable callable: never cached
+            return None
+        if value is not None:
+            self._entries.move_to_end((ref, key))
+        return value
+
+    def put(self, fn, key, value) -> None:
+        import weakref
+
+        try:
+            ref = weakref.ref(fn, self._purge)
+        except TypeError:
+            ref = fn
+        try:
+            self._entries[(ref, key)] = value
+            self._entries.move_to_end((ref, key))
+        except TypeError:  # unhashable callable: skip caching
+            return
+        while len(self._entries) > self.MAX_ENTRIES:
+            self._entries.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+
+_jvp_cache = _JvpCache()
 
 
 def jvp(fn: Callable, primals: tuple, tangents: tuple):
@@ -722,8 +1103,8 @@ def jvp(fn: Callable, primals: tuple, tangents: tuple):
     flat_t = [bridge.to_jax(x) for x in tree_flatten((tuple(tangents), {}))[0]
               if bridge.is_concrete_tensor(x)]
     # Key over every primal leaf — non-tensor primals are baked constants.
-    key = (id(fn), _meta_key(tree_flatten((tuple(primals), {}))[0]))
-    cached = _jvp_cache.get(key)
+    key = _meta_key(tree_flatten((tuple(primals), {}))[0])
+    cached = _jvp_cache.get(fn, key)
     if cached is not None:
         return jax.jvp(cached, tuple(flat_p), tuple(flat_t))
     for ex_list in (None, ["jax"]):
@@ -734,9 +1115,7 @@ def jvp(fn: Callable, primals: tuple, tangents: tuple):
             if ex_list is not None or not _is_kernel_transform_error(e):
                 raise
             continue
-        if len(_jvp_cache) > 256:
-            _jvp_cache.clear()
-        _jvp_cache[key] = flat_fn
+        _jvp_cache.put(fn, key, flat_fn)
         return result
 
 

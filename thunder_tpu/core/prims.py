@@ -275,11 +275,13 @@ def _unpack_sequence_meta(seq: Any, length: int) -> list:
 
 
 def _unpack_sequence_printer(bsym) -> str:
+    src = bsym.args[0]
+    src_s = src.name if isinstance(src, Proxy) else codeutils.prettyprint(src)
+    if not bsym.output:  # empty sequence: nothing to bind (check_len guards it)
+        return f"_ = {src_s}"
     outs = ", ".join(
         o.name if isinstance(o, Proxy) else codeutils.prettyprint(o) for o in bsym.output
     )
-    src = bsym.args[0]
-    src_s = src.name if isinstance(src, Proxy) else codeutils.prettyprint(src)
     return f"{outs}, = {src_s}" if len(bsym.output) == 1 else f"{outs} = {src_s}"
 
 

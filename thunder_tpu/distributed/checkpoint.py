@@ -22,7 +22,10 @@ class StateDictOptions:
 
     full_state_dict: bool = False  # gather to replicated host arrays before save
     cpu_offload: bool = False  # with full_state_dict: materialize on host memory
-    rank0_only: bool = True  # with full_state_dict: only process 0 writes
+    # Accepted for reference-API parity; no effect on behavior. With
+    # full_state_dict, Orbax writes the (replicated) gathered arrays from
+    # the primary host only — the consolidated export is always rank-0.
+    rank0_only: bool = True
 
 
 def _checkpointer(async_save: bool = False):
@@ -75,17 +78,20 @@ def save(
 
     Default: sharded save — every host writes its own shards via
     TensorStore. ``options.full_state_dict=True`` gathers to replicated
-    host arrays first; with ``rank0_only`` (the reference's consolidated
-    export) only process 0 writes the result. ``async_save=True`` returns
-    an AsyncSaveHandle and does the IO on a background thread.
+    host arrays first; Orbax then writes them from the primary host only
+    (the reference's rank0-consolidated export — ``rank0_only`` is
+    accepted for API parity but the consolidation always happens).
+    ``async_save=True`` returns an AsyncSaveHandle and does the IO on a
+    background thread.
     """
-    import jax
-
     options = options or StateDictOptions()
     if options.full_state_dict:
         state = _gather_full(state)
-        if options.rank0_only and jax.process_index() != 0:
-            return None
+        # rank0_only: every process must still enter ckptr.save — Orbax runs
+        # global sync barriers inside save(), so returning early on nonzero
+        # ranks deadlocks process 0 (ADVICE r4). After _gather_full the
+        # leaves are replicated host arrays, which Orbax writes from the
+        # primary host only — that IS the rank0-consolidated export.
     ckptr = _checkpointer(async_save=async_save)
     ckptr.save(os.path.abspath(path), state)
     if async_save:

@@ -17,9 +17,13 @@ Claims:
 Mask support (the reference's cudnnex builds its graph with a bias input;
 splash is mask-structured instead, so masks are handled by shape class):
 - ``attn_mask=None`` (+ optional ``is_causal``): claimed directly.
-- Key-padding masks — bool/additive of shape (B, S), (S,), (B, 1, 1, S),
-  (B, 1, S): lowered to splash segment-ids. Additive key-padding masks are
-  runtime-verified (entries must be 0 or very negative); on mismatch a
+- Key-padding masks — bool/additive of shape (S,), (B, 1, 1, S),
+  (1, 1, 1, S) (the torch-broadcast shapes that are constant over the
+  query axis; a 2D (X, S) mask aligns X with the QUERY dim in torch, so it
+  is NOT key-padding and takes the decomposition): lowered to splash
+  segment-ids. Additive key-padding masks are runtime-verified (entries
+  must be 0 or very negative), and any row with no valid key falls back —
+  torch's safe-softmax zeros vs kernel-defined output; on mismatch a
   ``lax.cond`` falls back to the exact decomposed SDPA, so claiming is
   always value-correct.
 - 4D float/bool masks (B, 1, Sq, Skv) — the shape HF builds for padded
@@ -351,10 +355,17 @@ def _sdpa_runtime(q, k, v, attn_mask, causal: bool, scale: float):
         m = jnp.broadcast_to(m, (B, Tkv))
         if kind == "keypad":
             kv_valid = m
-            return _splash_sdpa(q, k, v, causal=causal, scale=scale, kv_valid=kv_valid)
-        # additive key-padding: verify entries are 0 (keep) or <= _NEG_BIG (drop)
-        kv_valid = m == 0
-        ok = jnp.all(kv_valid | (m <= _NEG_BIG))
+            ok = jnp.ones((), dtype=jnp.bool_)
+        else:
+            # additive key-padding: entries must be 0 (keep) or <= _NEG_BIG (drop)
+            kv_valid = m == 0
+            ok = jnp.all(kv_valid | (m <= _NEG_BIG))
+        # A row with NO valid key must take the exact branch: torch's
+        # safe-softmax yields zeros there, while splash's output for a query
+        # with no matching segment is kernel-defined (ADVICE r4). Softmax
+        # shift-invariance also means an all-(-1e9) additive row attends
+        # normally in the exact path but masks everything in segment-ids.
+        ok = ok & jnp.all(jnp.any(kv_valid, axis=-1))
         return lax.cond(
             ok,
             lambda q, k, v: _splash_sdpa(q, k, v, causal=causal, scale=scale, kv_valid=kv_valid),

@@ -44,10 +44,19 @@ class QuantRecipe:
     + TE's DelayedScaling recipe): ``margin`` backs the scale off by
     2**margin (headroom against step-to-step amax growth — the role TE's
     history window plays), ``per_channel_weights`` selects row-wise weight
-    scales vs one per-tensor scale."""
+    scales vs one per-tensor scale.
+
+    ``skip_out_features`` is the seat of TE's ``skip_modules`` / exclusion
+    list: linears whose OUT dimension is listed stay in the original dtype.
+    In a functional trace there are no module names at claim time, but the
+    standard exclusion — the lm_head, whose out dim is the (padded) vocab
+    size and whose logits feed the loss directly — is exactly a shape
+    predicate. E.g. ``QuantRecipe(skip_out_features=(50304,))`` keeps
+    pythia's lm_head in bf16."""
 
     margin: int = 0
     per_channel_weights: bool = True
+    skip_out_features: tuple = ()
 
     @property
     def qmax(self) -> float:
@@ -79,6 +88,8 @@ def _linear_checker(a, w, bias=None) -> bool:
         return False
     if len(w.shape) != 2 or w.shape[1] < _MIN_K:
         return False
+    if int(w.shape[0]) in _recipe.skip_out_features:
+        return False  # excluded layer class (e.g. lm_head) stays full-precision
     # Quantization only replaces standard float matmuls; f64 (precision
     # contract) and integer linears stay with the default executor.
     if getattr(a, "dtype", None) not in _QUANTIZABLE or getattr(w, "dtype", None) not in _QUANTIZABLE:

@@ -1,65 +1,134 @@
-"""Pipeline parallelism: GPipe-style microbatch pipelining over a ``pp`` axis.
+"""Pipeline parallelism: GPipe and 1F1B schedules over a ``pp`` mesh axis.
 
 The reference has **no** pipeline parallelism (SURVEY.md §2.3: PP absent) —
-here it is a ~60-line differentiable schedule because the TPU mapping is
-natural: stages live on consecutive devices along the ``pp`` mesh axis,
-activations hop stage→stage with ``ppermute`` (nearest-neighbour ICI), and
-the whole schedule is one ``lax.scan`` — a single compiled program, no
-per-microbatch host dispatch.
+here the TPU mapping is natural: stages live on consecutive devices along
+the ``pp`` axis, activations hop stage→stage with ``ppermute``
+(nearest-neighbour ICI), and each schedule is one ``lax.scan`` — a single
+compiled SPMD program, no per-microbatch host dispatch.
 
-Semantics: ``n_micro`` microbatches flow through ``n_stages`` stages in
-``n_micro + n_stages − 1`` ticks (the classic GPipe fill/steady/drain
-schedule). Every op used (scan, ppermute, dynamic slicing, where-masking)
-has a transpose rule, so ``jax.grad`` through ``pipeline_apply`` IS
-pipeline-parallel backprop — the backward replays the schedule in reverse
-with cotangents hopping the ring the other way.
+Two schedules:
+
+- :func:`pipeline_apply` — GPipe forward. ``jax.grad`` through it IS
+  pipeline-parallel backprop (every op has a transpose rule); activation
+  residuals for ALL ``n_micro`` microbatches are stashed by scan's autodiff,
+  so memory grows with the microbatch count.
+- :func:`pipeline_1f1b` — explicit one-forward-one-backward schedule
+  computing (loss, param grads) in a single scan. Residuals are held in a
+  circular buffer of depth ``n_stages`` (the 1F1B in-flight bound): per-stage
+  activation memory is O(n_stages), independent of ``n_micro`` — the reason
+  real PP training uses 1F1B.
+
+Shape-changing stages (r5, VERDICT r4 #4): the first and last stages may
+differ from the trunk — ``first_fn`` (e.g. token embedding: ids → hidden)
+runs only on stage 0 and ``last_fn`` (e.g. final-norm+head+loss) only on the
+last stage, so a REAL transformer splits embed→blocks→head across the pipe.
+The inter-stage stream is the fixed-shape trunk activation; the microbatch
+input stream ``xs`` is whatever ``first_fn`` consumes (token ids — a few KB
+per microbatch, NOT the replicated hidden-state stream of the r4 design).
 """
 
 from __future__ import annotations
 
+from typing import Callable, Optional
 
-def pipeline_apply(stage_fn, local_params, xs, axis_name: str):
-    """Run ``stage_fn`` as a pipeline over the ``axis_name`` mesh axis.
 
-    Inside ``shard_map``:
-      stage_fn: (params, x) -> y with x/y of identical shape (stage i
-        consumes stage i−1's output).
-      local_params: THIS stage's parameter pytree (stack the per-stage
-        params outside and shard dim 0 over ``pp``; squeeze before passing).
-      xs: (n_micro, mb, ...) the full microbatch stream, replicated — only
-        stage 0 reads it.
+def _identity_first(params, x):
+    return x
 
-    Returns (n_micro, mb, ...) outputs, replicated across the axis (zeros
-    from non-final stages are psum-combined with the final stage's buffer).
+
+def _identity_last(params, y, mb):
+    return y
+
+
+def _index_stream(xs, i):
+    """Index a pytree of (n_micro, ...) streams at microbatch i."""
+    import jax
+    from jax import lax
+
+    return jax.tree_util.tree_map(
+        lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False), xs
+    )
+
+
+def _stream_len(xs) -> int:
+    import jax
+
+    return jax.tree_util.tree_leaves(xs)[0].shape[0]
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    local_params,
+    xs,
+    axis_name: str,
+    *,
+    first_fn: Optional[Callable] = None,
+    last_fn: Optional[Callable] = None,
+    act_shape: Optional[tuple] = None,
+    act_dtype=None,
+    out_shape: Optional[tuple] = None,
+    out_dtype=None,
+):
+    """GPipe forward over the ``axis_name`` mesh axis (inside shard_map).
+
+    stage_fn: (params, act) -> act — the trunk, shape-preserving.
+    first_fn: (params, microbatch) -> act — stage 0's input adapter
+      (default: identity, microbatch must already be act-shaped).
+    last_fn: (params, act, microbatch) -> out — the last stage's output
+      adapter (default: identity); receives the SAME microbatch element the
+      activation came from (e.g. its loss targets).
+    local_params: THIS stage's parameter pytree (stack per-stage params
+      outside, shard dim 0 over ``pp``, squeeze before passing; params only
+      used by first_fn/last_fn may be present on every stage — unused slots
+      are dead code on the others).
+    xs: a PYTREE of (n_micro, ...) streams (e.g. {"idx": ids, "tgt":
+      targets}); stage 0's first_fn and the last stage's last_fn read it.
+    act_shape/act_dtype: trunk activation shape (inferred from xs when
+      first_fn is None and xs is a single array).
+    out_shape/out_dtype: last_fn output shape (inferred: act).
+
+    Returns (n_micro,) + out_shape outputs, replicated across the axis.
+    ``n_micro + n_stages − 1`` ticks (the GPipe bubble).
     """
+    import jax
     import jax.numpy as jnp
     from jax import lax
 
+    first_fn = first_fn or _identity_first
+    last_fn = last_fn or _identity_last
+
     n_stages = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
-    n_micro = xs.shape[0]
+    n_micro = _stream_len(xs)
     ticks = n_micro + n_stages - 1
     perm = [(i, i + 1) for i in range(n_stages - 1)]
 
-    act0 = jnp.zeros(xs.shape[1:], xs.dtype)
-    outs0 = jnp.zeros_like(xs)
+    if act_shape is None:
+        leaf = jax.tree_util.tree_leaves(xs)[0]
+        act_shape, act_dtype = leaf.shape[1:], leaf.dtype
+    act0 = jnp.zeros(act_shape, act_dtype)
+    mb0 = _index_stream(xs, 0)
+    if out_shape is None:
+        out_eval = jax.eval_shape(lambda p, a, m: last_fn(p, a, m), local_params, act0, mb0)
+        out_shape, out_dtype = out_eval.shape, out_eval.dtype
+    outs0 = jnp.zeros((n_micro,) + tuple(out_shape), out_dtype)
 
     def tick(carry, t):
         act, outs = carry
-        # Activations hop one stage down the ring.
+        # Trunk activations hop one stage down the ring.
         recv = lax.ppermute(act, axis_name, perm)
-        # Stage 0 feeds the next microbatch during the fill/steady phase.
-        feed = jnp.where(
-            t < n_micro,
-            lax.dynamic_index_in_dim(xs, jnp.minimum(t, n_micro - 1), 0, keepdims=False),
-            jnp.zeros_like(act0),
-        )
+        # Stage 0 embeds the next microbatch during the fill/steady phase.
+        mb = _index_stream(xs, jnp.minimum(t, n_micro - 1))
+        fed = first_fn(local_params, mb)
+        feed = jnp.where(t < n_micro, fed, jnp.zeros_like(act0))
         x_in = jnp.where(stage == 0, feed, recv)
         y = stage_fn(local_params, x_in)
         # The final stage emits microbatch t − (n_stages − 1) once the
         # pipe is full; earlier ticks and other stages write nothing.
         j = t - (n_stages - 1)
-        updated = lax.dynamic_update_index_in_dim(outs, y, jnp.maximum(j, 0), 0)
+        mb_out = _index_stream(xs, jnp.clip(j, 0, n_micro - 1))
+        o = last_fn(local_params, y, mb_out)
+        updated = lax.dynamic_update_index_in_dim(outs, o, jnp.maximum(j, 0), 0)
         emit = jnp.logical_and(stage == n_stages - 1, j >= 0)
         outs = jnp.where(emit, updated, outs)
         return (y, outs), None
@@ -67,3 +136,157 @@ def pipeline_apply(stage_fn, local_params, xs, axis_name: str):
     (_, outs), _ = lax.scan(tick, (act0, outs0), jnp.arange(ticks))
     # Replicate the final stage's buffer to every device (others hold zeros).
     return lax.psum(outs, axis_name)
+
+
+def pipeline_1f1b(
+    stage_fn: Callable,
+    local_params,
+    xs,
+    axis_name: str,
+    *,
+    first_fn: Optional[Callable] = None,
+    last_fn: Optional[Callable] = None,
+    act_shape: Optional[tuple] = None,
+    act_dtype=None,
+):
+    """1F1B pipeline training step: ``(mean loss, param grads)`` in one scan.
+
+    ``last_fn(params, act, microbatch) -> scalar loss`` per microbatch; the
+    cotangent seeded into the backward is ``1/n_micro`` (mean over
+    microbatches). Residuals live in a depth-``n_stages`` circular buffer —
+    the 1F1B in-flight bound — so per-stage activation memory is
+    O(n_stages · |act|), independent of ``n_micro`` (GPipe-via-autodiff
+    stashes all ``n_micro``).
+
+    Schedule (classic non-interleaved 1F1B, expressed as a uniform SPMD
+    tick): stage ``s`` runs forward for microbatch ``f`` at tick
+    ``s + f`` and backward for microbatch ``b`` at tick
+    ``2·n_stages − 2 − s + 2·b + 1`` — between warmup and drain each stage
+    alternates one-forward/one-backward. Total ``2·(n_micro + n_stages − 1)``
+    ticks. Forward activations hop down the ring on even phases, cotangents
+    hop back up on odd phases.
+
+    Returns ``(loss_mean, grads)`` with ``grads`` matching ``local_params``
+    (each stage's grads for ITS OWN slice; first/last-stage-only params get
+    nonzero grads only where used — combine across stages outside if params
+    are stacked).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    first_fn = first_fn or _identity_first
+    if last_fn is None:
+        raise ValueError(
+            "pipeline_1f1b requires last_fn: (params, act, microbatch) -> "
+            "scalar loss — the schedule seeds its backward from it"
+        )
+
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = _stream_len(xs)
+    down = [(i, i + 1) for i in range(n_stages - 1)]
+    up = [(i + 1, i) for i in range(n_stages - 1)]
+
+    if act_shape is None:
+        leaf = jax.tree_util.tree_leaves(xs)[0]
+        act_shape, act_dtype = leaf.shape[1:], leaf.dtype
+    act0 = jnp.zeros(act_shape, act_dtype)
+
+    def fwd_one(mb, act_in):
+        """One stage-forward of one microbatch. Residual = the stage's INPUT
+        (microbatch for stage 0's first_fn path, trunk activation elsewhere)
+        — the backward recomputes the vjp from it (input-stashing 1F1B; the
+        per-stage recompute is one stage_fn forward, the standard
+        memory/time trade)."""
+        x_in = jnp.where(stage == 0, first_fn(local_params, mb), act_in)
+        return stage_fn(local_params, x_in)
+
+    def bwd_one(mb, act_in, ct_out):
+        """vjp of this stage's step for one microbatch: cotangent w.r.t. the
+        incoming trunk activation + this stage's param grads. The last stage
+        seeds from the loss instead of a received cotangent."""
+        def full(params, act):
+            x_in = jnp.where(stage == 0, first_fn(params, mb), act)
+            y = stage_fn(params, x_in)
+            loss = last_fn(params, y, mb)
+            is_last = stage == n_stages - 1
+            # Non-last stages: pull back ct_out through y. Last stage:
+            # pull back the mean-loss seed through the scalar loss.
+            return jnp.where(
+                is_last,
+                (loss / n_micro).astype(jnp.float32),
+                jnp.sum(y.astype(jnp.float32) * ct_out.astype(jnp.float32)),
+            )
+
+        val, (g_params, g_act) = jax.value_and_grad(full, argnums=(0, 1))(local_params, act_in)
+        # val IS loss/n_micro on the last stage (the seed); elsewhere it is
+        # the pullback inner product — the caller masks by stage.
+        return val, g_act, g_params
+
+    ticks = 2 * (n_micro + n_stages - 1)
+
+    saved_mb0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((n_stages,) + a.shape[1:], a.dtype), xs
+    )
+    saved_act0 = jnp.zeros((n_stages,) + tuple(act_shape), act_dtype)
+    # f32 grad accumulators: n_micro bf16 additions would lose low bits.
+    g0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), local_params
+    )
+    loss0 = jnp.zeros((), jnp.float32)
+
+    def tick(carry, t):
+        act_fwd, ct_bwd, saved_mb, saved_act, grads, loss_acc = carry
+
+        # ---- forward phase: stage s runs fwd(f) at tick s + 2f --------
+        f_idx = (t - stage) // 2
+        fwd_live = jnp.logical_and((t - stage) % 2 == 0,
+                                   jnp.logical_and(f_idx >= 0, f_idx < n_micro))
+
+        recv_act = lax.ppermute(act_fwd, axis_name, down)
+        mb = _index_stream(xs, jnp.clip(f_idx, 0, n_micro - 1))
+        act_in = jnp.where(stage == 0, jnp.zeros_like(act0), recv_act)
+        y = fwd_one(mb, act_in)
+        slot = jnp.clip(f_idx, 0, n_micro - 1) % n_stages
+        saved_mb = jax.tree_util.tree_map(
+            lambda buf, el: jnp.where(
+                fwd_live, lax.dynamic_update_index_in_dim(buf, el, slot, 0), buf
+            ),
+            saved_mb, mb,
+        )
+        saved_act = jnp.where(
+            fwd_live, lax.dynamic_update_index_in_dim(saved_act, act_in, slot, 0), saved_act
+        )
+        act_out = jnp.where(fwd_live, y, jnp.zeros_like(act0))
+
+        # ---- backward phase: stage s runs bwd(b) at tick
+        #      2·(n_stages−1) − s + 2b + 1 (opposite parity to fwd) -----
+        b_off = t - (2 * (n_stages - 1) - stage) - 1
+        b_idx = b_off // 2
+        bwd_live = jnp.logical_and(
+            b_off % 2 == 0, jnp.logical_and(b_idx >= 0, b_idx < n_micro)
+        )
+        recv_ct = lax.ppermute(ct_bwd, axis_name, up)
+        bslot = jnp.clip(b_idx, 0, n_micro - 1) % n_stages
+        r_mb = _index_stream(saved_mb, bslot)
+        r_act = lax.dynamic_index_in_dim(saved_act, bslot, 0, keepdims=False)
+        val, g_act, g_params = bwd_one(r_mb, r_act, recv_ct)
+        ct_out = jnp.where(bwd_live, g_act, jnp.zeros_like(act0))
+        grads = jax.tree_util.tree_map(
+            lambda g, gp: g + jnp.where(bwd_live, gp.astype(jnp.float32), 0.0),
+            grads, g_params,
+        )
+        # Loss tracking rides the backward's value_and_grad — no extra
+        # last_fn forward per tick: on the last stage val = loss/n_micro
+        # for the microbatch just backpropagated.
+        loss_acc = loss_acc + jnp.where(
+            jnp.logical_and(bwd_live, stage == n_stages - 1), val, 0.0
+        )
+
+        return (act_out, ct_out, saved_mb, saved_act, grads, loss_acc), None
+
+    init = (act0, jnp.zeros_like(act0), saved_mb0, saved_act0, g0, loss0)
+    (_, _, _, _, grads, loss_acc), _ = lax.scan(tick, init, jnp.arange(ticks))
+    loss = lax.psum(loss_acc, axis_name)  # loss_acc already carries 1/n_micro
+    return loss, grads

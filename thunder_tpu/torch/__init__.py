@@ -1461,14 +1461,14 @@ def _register_composite_vjps():
 
     @register_vjp("torch.scaled_dot_product_attention", checker=_sdpa_checker)
     def _sdpa_vjp(bsym, g):
+        from thunder_tpu.transforms.autodiff import grads_by_name
+
         b = _sdpa_args(bsym.args, bsym.kwargs)
         dq, dk, dv = sdpa_bwd(g, b["query"], b["key"], b["value"], b["attn_mask"],
                               b["is_causal"], b["scale"], b["enable_gqa"])
-        grads = [None] * len(bsym.args)
-        for i, name in enumerate(("query", "key", "value")):
-            if i < len(bsym.args):
-                grads[i] = (dq, dk, dv)[i]
-        return grads
+        names = ("query", "key", "value", "attn_mask", "dropout_p", "is_causal",
+                 "scale", "enable_gqa")
+        return grads_by_name(bsym, names, {"query": dq, "key": dk, "value": dv})
 
     def _ce_checker(input, target, weight=None, ignore_index=-100, reduction="mean", label_smoothing=0.0):
         return weight is None and float(pyval(label_smoothing)) == 0.0 and reduction in ("mean", "sum")
@@ -1491,31 +1491,35 @@ def _register_composite_vjps():
 
     @register_vjp("torch.layer_norm", checker=_ln_checker)
     def _layer_norm_vjp(bsym, g):
-        bound = dict(zip(("a", "normalized_shape", "weight", "bias", "eps"), bsym.args))
+        from thunder_tpu.transforms.autodiff import grads_by_name
+
+        names = ("a", "normalized_shape", "weight", "bias", "eps")
+        bound = dict(zip(names, bsym.args))
         bound.update(bsym.kwargs)
         eps = bound.get("eps", 1e-5)
         dx, dw, db = layer_norm_bwd(g, bound["a"], bound.get("weight"), bound.get("bias"),
                                     float(pyval(eps)))
-        grads = [None] * len(bsym.args)
-        grads[0] = dx
-        if bound.get("weight") is not None and len(bsym.args) >= 3:
-            grads[2] = dw
-        if bound.get("bias") is not None and len(bsym.args) >= 4:
-            grads[3] = db
-        return grads
+        grad_map = {"a": dx}
+        if bound.get("weight") is not None:
+            grad_map["weight"] = dw
+        if bound.get("bias") is not None:
+            grad_map["bias"] = db
+        return grads_by_name(bsym, names, grad_map)
 
     @register_vjp("torch.rms_norm", checker=_rms_checker)
     def _rms_norm_vjp(bsym, g):
-        bound = dict(zip(("a", "normalized_shape", "weight", "eps"), bsym.args))
+        from thunder_tpu.transforms.autodiff import grads_by_name
+
+        names = ("a", "normalized_shape", "weight", "eps")
+        bound = dict(zip(names, bsym.args))
         bound.update(bsym.kwargs)
         eps = bound.get("eps")
         dx, dw = rms_norm_bwd(g, bound["a"], bound.get("weight"),
                               1e-6 if eps is None else float(pyval(eps)))
-        grads = [None] * len(bsym.args)
-        grads[0] = dx
-        if bound.get("weight") is not None and len(bsym.args) >= 3:
-            grads[2] = dw
-        return grads
+        grad_map = {"a": dx}
+        if bound.get("weight") is not None:
+            grad_map["weight"] = dw
+        return grads_by_name(bsym, names, grad_map)
 
     @register_vjp("torch.apply_rope")
     def _rope_vjp(bsym, g):

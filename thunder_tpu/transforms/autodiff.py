@@ -58,6 +58,24 @@ _vjp_checkers: dict[Any, Callable] = {}
 NONDIFF = object()  # registered marker: op treated as constant
 
 
+def grads_by_name(bsym, names: Sequence[str], grad_map: dict):
+    """Align a {param_name: grad} map with ``args + kwargs.values()``.
+
+    Composite VJP rules receive operands that may arrive positionally OR as
+    keywords depending on the call site; the reverse walk zips grads against
+    ``tuple(bsym.args) + tuple(bsym.kwargs.values())``, so a rule must place
+    each grad at its operand's actual slot. ``names`` is the composite's
+    positional parameter order."""
+    flat = [None] * (len(bsym.args) + len(bsym.kwargs))
+    pos_of = {nm: i for i, nm in enumerate(names[: len(bsym.args)])}
+    for i, nm in enumerate(bsym.kwargs):
+        pos_of.setdefault(nm, len(bsym.args) + i)
+    for nm, g in grad_map.items():
+        if g is not None and nm in pos_of:
+            flat[pos_of[nm]] = g
+    return flat
+
+
 def register_vjp(sym_id, checker: Optional[Callable] = None):
     def deco(fn):
         _vjp_rules[sym_id] = fn
@@ -760,7 +778,14 @@ class BackwardBuilder:
             # Multi-output prims get a cotangent slot per output (None where
             # no gradient flows); single-output prims get exactly one.
             grads = rule(bsym, *cts)
-            self._accumulate_grads(bsym.args, grads)
+            # Cotangents accumulate onto the FULL binding — positional args
+            # first, then kwarg values in recorded order. A composite whose
+            # differentiable operand arrived as a keyword (e.g. ltorch.
+            # layer_norm(..., weight=w)) would otherwise silently drop its
+            # grad (r5: zero LayerNorm grads through the module frontend).
+            self._accumulate_grads(
+                tuple(bsym.args) + tuple(bsym.kwargs.values()), grads
+            )
 
     def _accumulate_grads(self, args, grads) -> None:
         for a, g in zip(args, grads):
