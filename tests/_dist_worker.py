@@ -850,6 +850,121 @@ def scenario_batch_reduced_output():
     print("batch_reduced_output OK")
 
 
+def scenario_moe_capacity():
+    """VERDICT r4 #10: the production capacity path UNDER token drops.
+    capacity below the lossless bound on the 8-device mesh: the dropped
+    assignment count matches an independent numpy replication of the
+    per-(source device, expert) slot accounting, the surviving tokens'
+    outputs match a drop-aware dense oracle, and training with drops
+    still converges."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from thunder_tpu.parallel import make_mesh
+    from thunder_tpu.parallel.moe import moe_mlp
+
+    try:
+        from jax.experimental.shard_map import shard_map
+    except ImportError:
+        from jax.shard_map import shard_map
+
+    mesh = make_mesh(ep=8)
+    E, d, hdim, n_total, top_k, C = 16, 32, 64, 64, 2, 1  # n_local=8, C=1 << lossless
+    rng = np.random.RandomState(1)
+    x = rng.randn(n_total, d).astype(np.float32) * 0.5
+    rw = rng.randn(d, E).astype(np.float32) * 0.3
+    w1 = rng.randn(E, d, hdim).astype(np.float32) * 0.2
+    w2 = rng.randn(E, hdim, d).astype(np.float32) * 0.2
+
+    def softmax_np(z):
+        e = np.exp(z - z.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    # numpy replication of the routing/capacity bookkeeping (independent of
+    # the jax implementation: plain loops, not einsums)
+    def route_shard(xs):
+        probs = softmax_np(xs @ rw)
+        order = np.argsort(-probs, axis=-1, kind="stable")[:, :top_k]
+        top_p = np.take_along_axis(probs, order, axis=-1)
+        slots_used = np.zeros(E, dtype=int)
+        keep = np.zeros((xs.shape[0], top_k), dtype=bool)
+        for t in range(xs.shape[0]):
+            for k in range(top_k):
+                e = order[t, k]
+                if slots_used[e] < C:
+                    keep[t, k] = True
+                    slots_used[e] += 1
+        return order, top_p, keep
+
+    n_local = n_total // 8
+    total_kept = 0
+    want = np.zeros_like(x)
+
+    def expert_np(z, e):
+        h = z @ w1[e]
+        # jax.nn.gelu's default tanh approximation
+        h = 0.5 * h * (1.0 + np.tanh(np.sqrt(2.0 / np.pi) * (h + 0.044715 * h ** 3)))
+        return h @ w2[e]
+
+    for s in range(8):
+        xs = x[s * n_local:(s + 1) * n_local]
+        order, top_p, keep = route_shard(xs)
+        total_kept += int(keep.sum())
+        for t in range(n_local):
+            acc = np.zeros(d, dtype=np.float64)
+            for k in range(top_k):
+                if keep[t, k]:
+                    acc += top_p[t, k] * expert_np(xs[t], order[t, k])
+            want[s * n_local + t] = acc
+    total_assignments = n_total * top_k
+    dropped = total_assignments - total_kept
+    # C=1 per (device, expert): each device keeps at most E slots = 16 of
+    # its 16 assignments only if spread perfectly; real routing concentrates
+    # so drops MUST occur.
+    assert dropped > 0, "capacity below the lossless bound must drop tokens"
+
+    ep_fn = shard_map(
+        lambda x, rw, w1, w2: moe_mlp(x, rw, w1, w2, "ep", top_k=top_k, capacity=C),
+        mesh=mesh,
+        in_specs=(P("ep", None), P(), P("ep", None, None), P("ep", None, None)),
+        out_specs=P("ep", None),
+        check_rep=False,
+    )
+    got = np.asarray(jax.jit(ep_fn)(
+        jnp.asarray(x), jnp.asarray(rw), jnp.asarray(w1), jnp.asarray(w2)
+    ))
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=2e-3, atol=2e-4)
+    # The drop count is visible in the outputs: tokens with every choice
+    # dropped are exactly zero.
+    zero_rows = int((np.abs(got).max(axis=1) < 1e-7).sum())
+    want_zero_rows = int((np.abs(want).max(axis=1) == 0.0).sum())
+    assert zero_rows == want_zero_rows, (zero_rows, want_zero_rows)
+    print(f"moe capacity OK: {dropped}/{total_assignments} assignments dropped, "
+          f"{zero_rows} fully-dropped tokens, outputs match drop-aware oracle")
+
+    # Training under drops converges: gradients flow through the dispatch/
+    # combine einsums and both all_to_alls even with dropped assignments
+    # (the keep mask is zero-grad at the drop boundary, fine for SGD).
+    jrw, jw1, jw2 = jnp.asarray(rw), jnp.asarray(w1), jnp.asarray(w2)
+
+    @jax.jit
+    def step(rw, w1, w2):
+        def loss(rw, w1, w2):
+            out = ep_fn(jnp.asarray(x), rw, w1, w2)
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        l, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(rw, w1, w2)
+        return l, tuple(p - 0.02 * gp for p, gp in zip((rw, w1, w2), g))
+
+    l0 = None
+    for _ in range(15):
+        loss, (jrw, jw1, jw2) = step(jrw, jw1, jw2)
+        l0 = float(loss) if l0 is None else l0
+    assert float(loss) < 0.4 * l0, (l0, float(loss))
+    print(f"moe capacity training OK: loss {l0:.3f} -> {float(loss):.3f}")
+
+
 def scenario_gpt_pipeline():
     """VERDICT r4 #4: a REAL models/gpt.py transformer split embed→blocks→
     head over pp=4 — loss + grad parity vs the single-device staged oracle

@@ -1,0 +1,351 @@
+"""Adversarial program-acquisition tests (VERDICT r4 missing #6).
+
+Reference parity bar: thunder/tests/test_interpreter.py +
+test_jit_functional.py pin the bytecode VM against hostile Python. The
+dispatch frontend has no VM, but the same *behaviors* must hold: closures,
+generators, aliased inputs, kwargs-only calls, defaults, *args forwarding,
+dict/list plumbing, recursion, and exception paths must all acquire
+correctly and produce torch-parity results.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+import torch.nn.functional as F  # noqa: E402
+
+import thunder_tpu  # noqa: E402
+import thunder_tpu.clang as clang  # noqa: E402
+import thunder_tpu.torch as ttorch  # noqa: E402
+
+
+def _r(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+class TestFunctionalAcquisition:
+    def test_closure_over_tensor(self):
+        w = _r(4, 4, seed=1)
+
+        def outer(x):
+            def inner(y):
+                return ttorch.sum(y @ w + x)  # closes over BOTH w and x
+
+            return inner(x * 2.0)
+
+        got = float(np.asarray(thunder_tpu.jit(outer)(_r(4, 4))))
+        x = _r(4, 4)
+        want = float((x * 2.0 @ w + x).sum())
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_closure_mutating_cell(self):
+        def f(x):
+            acc = x * 0.0
+
+            def add(v):
+                nonlocal acc
+                acc = acc + v
+
+            for i in range(3):
+                add(x * float(i))
+            return ttorch.sum(acc)
+
+        got = float(np.asarray(thunder_tpu.jit(f)(_r(3, 3))))
+        want = float((_r(3, 3) * 3.0).sum())  # 0+1+2
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_generator_expression_and_comprehension(self):
+        def f(xs):
+            halves = [x * 0.5 for x in xs]
+            total = sum(ttorch.sum(h) for h in halves)
+            return total
+
+        xs = [_r(2, 2, seed=i) for i in range(4)]
+        got = float(np.asarray(thunder_tpu.jit(f)(xs)))
+        want = sum(0.5 * x.sum() for x in xs)
+        np.testing.assert_allclose(got, float(want), rtol=1e-4)
+
+    def test_yielding_generator_function(self):
+        def gen(x):
+            for i in range(3):
+                yield x * float(i + 1)
+
+        def f(x):
+            out = x * 0.0
+            for piece in gen(x):
+                out = out + piece
+            return ttorch.sum(out)
+
+        got = float(np.asarray(thunder_tpu.jit(f)(_r(3,))))
+        np.testing.assert_allclose(got, 6.0 * _r(3,).sum(), rtol=1e-4)
+
+    def test_aliased_inputs_same_object(self):
+        def f(a, b):
+            return ttorch.sum(a * b)  # caller passes the SAME array twice
+
+        x = _r(4, 4, seed=2)
+        got = float(np.asarray(thunder_tpu.jit(f)(x, x)))
+        np.testing.assert_allclose(got, float((x * x).sum()), rtol=1e-4)
+
+    def test_kwargs_only_call(self):
+        def f(*, a, b, scale=1.0):
+            return ttorch.sum(a + b) * scale
+
+        a, b = _r(3, 3, seed=3), _r(3, 3, seed=4)
+        got = float(np.asarray(thunder_tpu.jit(f)(a=a, b=b, scale=2.0)))
+        np.testing.assert_allclose(got, 2.0 * float((a + b).sum()), rtol=1e-4)
+
+    def test_star_args_forwarding(self):
+        def helper(*tensors, weight=1.0):
+            out = tensors[0] * 0.0
+            for t in tensors:
+                out = out + t * weight
+            return out
+
+        def f(a, b, c):
+            return ttorch.sum(helper(a, b, c, weight=0.5))
+
+        a, b, c = (_r(2, 2, seed=i) for i in (5, 6, 7))
+        got = float(np.asarray(thunder_tpu.jit(f)(a, b, c)))
+        np.testing.assert_allclose(got, 0.5 * float((a + b + c).sum()), rtol=1e-4)
+
+    def test_recursion(self):
+        def power(x, n):
+            if n == 0:
+                return x * 0.0 + 1.0
+            return x * power(x, n - 1)
+
+        x = _r(3, seed=8) * 0.5
+        got = np.asarray(thunder_tpu.jit(lambda a: power(a, 3))(x))
+        np.testing.assert_allclose(got, x ** 3, rtol=1e-4, atol=1e-6)
+
+    def test_try_except_non_tensor(self):
+        def f(x):
+            try:
+                _ = {}["missing"]
+            except KeyError:
+                scale = 3.0
+            return ttorch.sum(x) * scale
+
+        x = _r(4, seed=9)
+        got = float(np.asarray(thunder_tpu.jit(f)(x)))
+        np.testing.assert_allclose(got, 3.0 * x.sum(), rtol=1e-4)
+
+    def test_dict_plumbing_and_nested_containers(self):
+        def f(cfg):
+            layers = cfg["layers"]
+            x = cfg["input"]["x"]
+            for spec in layers:
+                x = x @ spec["w"] + spec.get("b", 0.0)
+            return ttorch.sum(x)
+
+        cfg = {
+            "input": {"x": _r(2, 4, seed=10)},
+            "layers": [
+                {"w": _r(4, 4, seed=11), "b": _r(4, seed=12)},
+                {"w": _r(4, 4, seed=13)},
+            ],
+        }
+        got = float(np.asarray(thunder_tpu.jit(f)(cfg)))
+        x = cfg["input"]["x"] @ cfg["layers"][0]["w"] + cfg["layers"][0]["b"]
+        want = float((x @ cfg["layers"][1]["w"]).sum())
+        # chained f32 matmuls: TPU MXU accumulation order differs from numpy
+        np.testing.assert_allclose(got, want, rtol=5e-3)
+
+    def test_zip_enumerate_reversed(self):
+        def f(xs, ys):
+            out = xs[0] * 0.0
+            for i, (a, b) in enumerate(zip(xs, reversed(ys))):
+                out = out + a * b * float(i + 1)
+            return ttorch.sum(out)
+
+        xs = [_r(2, 2, seed=i) for i in (14, 15)]
+        ys = [_r(2, 2, seed=i) for i in (16, 17)]
+        got = float(np.asarray(thunder_tpu.jit(f)(xs, ys)))
+        want = float((xs[0] * ys[1] * 1 + xs[1] * ys[0] * 2).sum())
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+class TestModuleAcquisitionAdversarial:
+    def test_module_with_helper_methods_and_properties(self):
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+
+            @property
+            def scale(self):
+                return 0.5
+
+            def _helper(self, x):
+                return F.gelu(self.fc(x)) * self.scale
+
+            def forward(self, x):
+                return self._helper(x) + self._helper(x * 2.0)
+
+        torch.manual_seed(0)
+        m = M().eval()
+        x = torch.randn(4, 8)
+        got = thunder_tpu.jit(M().eval().requires_grad_(False))  # fresh module
+        got._module.load_state_dict(m.state_dict())
+        got.resync_params() if hasattr(got, "resync_params") else None
+        torch.testing.assert_close(got(x), m(x), rtol=1e-3, atol=1e-4)
+
+    def test_module_dict_and_modulelist(self):
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.blocks = nn.ModuleList([nn.Linear(6, 6) for _ in range(3)])
+                self.heads = nn.ModuleDict({"a": nn.Linear(6, 2), "b": nn.Linear(6, 3)})
+
+            def forward(self, x):
+                for blk in self.blocks:
+                    x = torch.tanh(blk(x))
+                return self.heads["a"](x).sum() + self.heads["b"](x).sum()
+
+        torch.manual_seed(1)
+        m = M().eval()
+        tm = thunder_tpu.jit(m)
+        x = torch.randn(5, 6)
+        torch.testing.assert_close(tm(x), m(x), rtol=1e-3, atol=1e-4)
+
+    def test_kwargs_only_module_forward(self):
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, *, input_ids=None, attention=None):
+                h = self.fc(input_ids)
+                if attention is not None:
+                    h = h * attention
+                return h.sum()
+
+        torch.manual_seed(2)
+        m = M().eval()
+        tm = thunder_tpu.jit(m)
+        x, att = torch.randn(3, 4), torch.rand(3, 4)
+        torch.testing.assert_close(tm(input_ids=x, attention=att),
+                                   m(input_ids=x, attention=att), rtol=1e-3, atol=1e-4)
+
+    def test_aliased_module_inputs(self):
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, a, b):
+                return (self.fc(a) * b).sum()
+
+        torch.manual_seed(3)
+        m = M().eval()
+        tm = thunder_tpu.jit(m)
+        x = torch.randn(2, 4)
+        torch.testing.assert_close(tm(x, x), m(x, x), rtol=1e-3, atol=1e-4)
+
+    def test_shared_submodule_weight_tying(self):
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(16, 8)
+                self.head = nn.Linear(8, 16, bias=False)
+                self.head.weight = self.emb.weight  # tied
+
+            def forward(self, idx):
+                return self.head(self.emb(idx)).sum()
+
+        torch.manual_seed(4)
+        m = M().eval()
+        tm = thunder_tpu.jit(m)
+        idx = torch.randint(0, 16, (3, 5))
+        torch.testing.assert_close(tm(idx), m(idx), rtol=1e-3, atol=1e-4)
+
+    def test_tied_weight_grads_accumulate(self):
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(8, 4)
+                self.head = nn.Linear(4, 8, bias=False)
+                self.head.weight = self.emb.weight
+
+            def forward(self, idx):
+                return self.head(self.emb(idx)).float().pow(2).mean()
+
+        torch.manual_seed(5)
+        m_ref = M()
+        m_jit = M()
+        m_jit.load_state_dict(m_ref.state_dict())
+        tm = thunder_tpu.jit(m_jit)
+        idx = torch.randint(0, 8, (2, 6))
+        tm(idx).backward()
+        m_ref(idx).backward()
+        torch.testing.assert_close(m_jit.emb.weight.grad, m_ref.emb.weight.grad,
+                                   rtol=2e-3, atol=1e-4)
+
+
+class TestCapturedTensorConstants:
+    """r5: concrete arrays captured from the enclosing scope (closures,
+    globals, defaults) are lifted into the trace as BAKED constants
+    (prims.tensor_constant) — the dispatch-frontend seat of the VM's
+    provenance-tracked closure loads."""
+
+    def test_captured_array_is_baked(self):
+        w = np.ones(3, dtype=np.float32)
+
+        def f(x):
+            return ttorch.sum(x * w)
+
+        jf = thunder_tpu.jit(f)
+        assert float(np.asarray(jf(np.ones(3, dtype=np.float32)))) == 3.0
+        src = thunder_tpu.last_traces(jf)[0].python()
+        assert "_tconst" in src, src
+        # Baked: later mutation of the captured array is invisible (same
+        # contract as a captured Python number).
+        w *= 100.0
+        assert float(np.asarray(jf(np.ones(3, dtype=np.float32)))) == 3.0
+
+    def test_grad_flows_around_constant(self):
+        torch = pytest.importorskip("torch")
+        w = _r(4, 4, seed=20)
+        x = _r(2, 4, seed=21)
+
+        def f(x):
+            return ttorch.sum((x @ w) ** 2)
+
+        val, (gx,) = thunder_tpu.value_and_grad(f)(x)
+        tx = torch.from_numpy(x).requires_grad_()
+        (tx @ torch.from_numpy(w)).pow(2).sum().backward()
+        np.testing.assert_allclose(np.asarray(gx), tx.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+    def test_torch_tensor_closure_in_module(self):
+        torch = pytest.importorskip("torch")
+
+        mask = torch.tril(torch.ones(6, 6))
+
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(6, 6)
+
+            def forward(self, x):
+                return (self.fc(x) * mask).sum()  # closes over a raw tensor
+
+        torch.manual_seed(7)
+        m = M().eval()
+        tm = thunder_tpu.jit(m)
+        x = torch.randn(6, 6)
+        torch.testing.assert_close(tm(x), m(x), rtol=1e-3, atol=1e-4)
+
+    def test_constant_memo_bakes_once(self):
+        """The same captured array used by several ops bakes ONE constant."""
+        w = _r(3, 3, seed=30)
+
+        def f(x):
+            return ttorch.sum(x * w + w)  # two uses of the same capture
+
+        jf = thunder_tpu.jit(f)
+        jf(_r(3, 3, seed=31))
+        src = thunder_tpu.last_traces(jf)[0].python()
+        assert src.count("tensor_constant") <= 2  # one bind line + maybe repr
+        assert src.count("_tconst_") == 1, src

@@ -192,6 +192,11 @@ class TestExpertAndPipelineParallel:
     def test_moe_ep(self):
         _run_scenario("moe_ep")
 
+    def test_moe_capacity(self):
+        """r5: capacity below the lossless bound — drop accounting vs a
+        numpy oracle, drop-aware output parity, training under drops."""
+        _run_scenario("moe_capacity")
+
     def test_pipeline_pp(self):
         _run_scenario("pipeline_pp")
 

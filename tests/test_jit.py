@@ -447,3 +447,29 @@ class TestInputMutationEpilogue:
 
         with pytest.raises(NotImplementedError, match="mutates its inputs"):
             ttpu.grad(f)(np.ones(3, dtype=np.float32), [])
+
+    def test_tuple_value_replacement_replayed(self):
+        """r5 review: rebinding a dict slot to a NEW tuple must be recorded
+        (tuples are immutable — recursion alone would drop the write)."""
+        def f(d):
+            d["pair"] = (clang.mul(d["x"], 2.0), 5)
+            return clang.sum(d["x"], (0,))
+
+        jf = ttpu.jit(f)
+        d = {"x": np.ones(3, dtype=np.float32), "pair": (None, 0)}
+        jf(d)
+        assert isinstance(d["pair"], tuple) and d["pair"][1] == 5
+        np.testing.assert_allclose(np.asarray(d["pair"][0]), 2.0 * np.ones(3))
+
+    def test_nested_container_value_not_false_positive(self):
+        """r5 regression: pure READS of nested containers (incl. tuple-valued
+        kwargs) must not be recorded as mutations (the pristine copy has
+        fresh container objects at every level)."""
+        def f(d, size=None):
+            return clang.mul(d["x"], float(len(size)))
+
+        jf = ttpu.jit(f)
+        d = {"x": np.ones(3, dtype=np.float32), "cfg": {"mode": "a", "dims": (1, 2)}}
+        jf(d, size=(8, 3))
+        entry = jf._lc_cs.cache_entries[-1]
+        assert entry.epilogue_fn is None, "read-only inputs produced an epilogue"

@@ -228,29 +228,67 @@ def _mutation_value_spec(v: Any, extras: list):
     return ("const", v)
 
 
+def _same_container_type(a: Any, b: Any) -> bool:
+    return (
+        (isinstance(a, dict) and isinstance(b, dict))
+        or (isinstance(a, list) and isinstance(b, list))
+        or (isinstance(a, tuple) and isinstance(b, tuple))
+    )
+
+
+def _tuple_replaced(cur: tuple, orig: tuple) -> bool:
+    """Did a tuple VALUE change? Tuples are immutable, so any leaf identity
+    difference means the enclosing slot was rebound to a new tuple — the
+    parent must record a wholesale set (recursion alone would drop it)."""
+    if len(cur) != len(orig):
+        return True
+    for a, b in zip(cur, orig):
+        if isinstance(a, tuple) and isinstance(b, tuple):
+            if _tuple_replaced(a, b):
+                return True
+        elif _same_container_type(a, b):
+            continue  # mutable containers inside tuples: diffed in place
+        elif a is not b:
+            return True
+    return False
+
+
 def _diff_container_tree(cur: Any, orig: Any, path: tuple, muts: list, extras: list) -> None:
     """Record container mutations fn made to its (proxied) inputs.
 
     Reference parity: thunder/core/jit_ext.py `process_recorded_modifications
     :1302` — the VM records STORE_SUBSCR et al.; here the proxied containers
-    are diffed against a pristine structural copy after tracing."""
+    are diffed against a pristine structural copy after tracing. The pristine
+    copy has FRESH container objects at every level, so container-typed
+    values are compared by recursion, never by identity."""
     if isinstance(orig, dict) and isinstance(cur, dict):
         for k in orig:
             if k not in cur:
                 muts.append(("del", path, k))
         for k, v in cur.items():
             ov = orig.get(k, _MISSING)
-            if ov is _MISSING or ov is not v:
-                muts.append(("set", path, k, _mutation_value_spec(v, extras)))
-            else:
+            if isinstance(v, tuple) and isinstance(ov, tuple):
+                if _tuple_replaced(v, ov):
+                    muts.append(("set", path, k, _mutation_value_spec(v, extras)))
+                else:
+                    _diff_container_tree(v, ov, path + (k,), muts, extras)
+            elif _same_container_type(v, ov):
                 _diff_container_tree(v, ov, path + (k,), muts, extras)
+            elif ov is _MISSING or ov is not v:
+                muts.append(("set", path, k, _mutation_value_spec(v, extras)))
     elif isinstance(orig, list) and isinstance(cur, list):
-        if len(cur) != len(orig) or any(a is not b for a, b in zip(cur, orig)):
+        if len(cur) != len(orig) or any(
+            (a is not b and not _same_container_type(a, b))
+            or (isinstance(a, tuple) and isinstance(b, tuple) and _tuple_replaced(a, b))
+            for a, b in zip(cur, orig)
+        ):
             muts.append(("resync", path, [_mutation_value_spec(v, extras) for v in cur]))
         else:
             for i, (a, b) in enumerate(zip(cur, orig)):
                 _diff_container_tree(a, b, path + (i,), muts, extras)
     elif isinstance(orig, tuple) and isinstance(cur, tuple) and len(orig) == len(cur):
+        # Top-level / nested positional structure: elements can't be rebound
+        # in the CALLER (tuples are immutable), so recursion alone is right.
         for i, (a, b) in enumerate(zip(cur, orig)):
             _diff_container_tree(a, b, path + (i,), muts, extras)
 

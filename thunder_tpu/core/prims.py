@@ -66,6 +66,7 @@ class OpTags(enum.Enum):
 class PrimIDs(enum.Enum):
     # Unpacking and checking (prologue guards)
     UNPACK_TRIVIAL = enum.auto()
+    TENSOR_CONSTANT = enum.auto()
     UNPACK_SEQUENCE = enum.auto()
     UNPACK_KEY = enum.auto()
     UNPACK_ATTR = enum.auto()
@@ -258,6 +259,77 @@ unpack_trivial = make_prim(
     tags=(OpTags.UNPACK_OP, OpTags.DONT_DCE),
     python_printer=_unpack_trivial_printer,
 )
+
+
+class _ConstHandle:
+    """Identity-hashable wrapper keeping a concrete array OFF the bound
+    symbol's printable/hashable surface (CSE keys, repr) while remaining in
+    its args for liveness."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+    def __repr__(self) -> str:
+        return f"<const {getattr(self.value, 'shape', ())}>"
+
+
+def _tensor_constant_meta(handle: _ConstHandle):
+    from thunder_tpu.core.proxies import tensorproxy_from_concrete
+
+    return tensorproxy_from_concrete(handle.value)
+
+
+def _tensor_constant_printer(bsym) -> str:
+    key = next(iter(bsym._call_ctx))
+    return f"{bsym.output.name} = {key}"
+
+
+def _tensor_constant_bind(bsym) -> None:
+    handle = bsym.args[0]
+    bsym._call_ctx[f"_tconst_{id(handle)}"] = handle.value
+
+
+tensor_constant_sym = make_prim(
+    PrimIDs.TENSOR_CONSTANT,
+    "tensor_constant",
+    _tensor_constant_meta,
+    python_printer=_tensor_constant_printer,
+)
+tensor_constant_sym._bind_postprocess = _tensor_constant_bind
+
+
+def tensor_constant(value):
+    """Lift a concrete array (numpy/torch/jax) captured from the enclosing
+    Python scope into the trace as a BAKED constant.
+
+    Reference analogue: the bytecode VM proxies tensors wherever it loads
+    them (closures, globals, defaults — interpreter.py provenance records);
+    the dispatch frontend lifts them at the op boundary instead. The value
+    is bound into the generated program's exec namespace via the bound
+    symbol's call ctx — it is part of the compiled program, NOT a guarded
+    input (mutating the captured array later is invisible, exactly like a
+    baked Python-number constant).
+
+    Per-trace memo: the same captured object used by N ops bakes ONE
+    constant (one device buffer, one bound symbol) — identity-hashed
+    handles would otherwise defeat CSE and pin N copies."""
+    from thunder_tpu.core.trace import get_tracectx
+    from thunder_tpu.executors import bridge
+
+    trc = get_tracectx()
+    memo = getattr(trc, "_tconst_memo", None)
+    if memo is None:
+        memo = trc._tconst_memo = {}
+    hit = memo.get(id(value))
+    if hit is not None:
+        return hit[1]
+    proxy = tensor_constant_sym(_ConstHandle(bridge.to_jax(value)))
+    # Keep the source object alive for the trace's lifetime so its id can't
+    # be reused by a different array.
+    memo[id(value)] = (value, proxy)
+    return proxy
 
 
 def _unpack_sequence_meta(seq: Any, length: int) -> list:

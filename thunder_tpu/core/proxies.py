@@ -343,6 +343,28 @@ def pytype(x: Any) -> type:
 ShapeLike = Sequence[int]
 
 
+def _lift_operand(x):
+    """Concrete array operand of a proxy op -> baked tensor constant (only
+    meaningful inside a trace; passthrough otherwise).
+
+    NOT redundant with Symbol.__call__'s lifting: clang language methods are
+    plain wrapper FUNCTIONS that run dtype promotion/broadcast logic before
+    any Symbol is called (clang/__init__._elementwise_binary_wrapper), so a
+    raw array must be lifted before dispatch reaches them; the torch
+    language's methods are Symbols and simply see an already-lifted proxy.
+    Both layers memoize through prims.tensor_constant's per-trace memo."""
+    from thunder_tpu.executors import bridge
+
+    if bridge.is_concrete_tensor(x):
+        from thunder_tpu.core.trace import get_tracectx
+
+        if get_tracectx() is not None:
+            from thunder_tpu.core import prims
+
+            return prims.tensor_constant(x)
+    return x
+
+
 class TensorProxy(Proxy):
     """The abstract tensor: shape, dtype, device, requires_grad, distributed
     layout, and (TPU-first) an optional named-axis sharding spec.
@@ -503,6 +525,10 @@ class TensorProxy(Proxy):
     # -- method / operator dispatch via the active language ------------------
 
     def _dispatch(self, name: str, *args, **kwargs):
+        # proxy <op> captured-concrete-array: lift the array to a baked
+        # trace constant before language methods inspect dtypes (the
+        # closure/global/default capture cases; prims.tensor_constant).
+        args = tuple(_lift_operand(a) for a in args)
         method = resolve_method(name, self, *args, **kwargs)
         if method is None:
             raise AttributeError(f"No language method {name!r} for TensorProxy")
@@ -525,24 +551,28 @@ class TensorProxy(Proxy):
         return self._dispatch("add", other)
 
     def __radd__(self, other):
+        other = _lift_operand(other)
         return resolve_method("add", other, self)(other, self)
 
     def __sub__(self, other):
         return self._dispatch("sub", other)
 
     def __rsub__(self, other):
+        other = _lift_operand(other)
         return resolve_method("sub", other, self)(other, self)
 
     def __mul__(self, other):
         return self._dispatch("mul", other)
 
     def __rmul__(self, other):
+        other = _lift_operand(other)
         return resolve_method("mul", other, self)(other, self)
 
     def __truediv__(self, other):
         return self._dispatch("true_divide", other)
 
     def __rtruediv__(self, other):
+        other = _lift_operand(other)
         return resolve_method("true_divide", other, self)(other, self)
 
     def __floordiv__(self, other):
@@ -555,12 +585,14 @@ class TensorProxy(Proxy):
         return self._dispatch("pow", other)
 
     def __rpow__(self, other):
+        other = _lift_operand(other)
         return resolve_method("pow", other, self)(other, self)
 
     def __matmul__(self, other):
         return self._dispatch("matmul", other)
 
     def __rmatmul__(self, other):
+        other = _lift_operand(other)
         return resolve_method("matmul", other, self)(other, self)
 
     def __neg__(self):

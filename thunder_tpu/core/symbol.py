@@ -49,6 +49,42 @@ def resolve_inplace_tree(tree: Any) -> Any:
     return tree_unflatten(spec, [resolve_inplace(x) for x in flat])
 
 
+def _lift_captured_tensors(args: tuple, kwargs: dict):
+    """Replace concrete arrays (numpy/torch/jax) in a traced op's operands
+    with baked tensor-constant proxies (prims.tensor_constant). Shallow +
+    one list/tuple level; no-op when nothing concrete is present."""
+    from thunder_tpu.executors import bridge
+
+    def lift_one(x):
+        if bridge.is_concrete_tensor(x):
+            from thunder_tpu.core import prims
+
+            return prims.tensor_constant(x)
+        return x
+
+    def lift(x):
+        if isinstance(x, (list, tuple)) and any(
+            bridge.is_concrete_tensor(v) for v in x
+        ):
+            return type(x)(lift_one(v) for v in x)
+        return lift_one(x)
+
+    if not (
+        any(_has_concrete(a) for a in args)
+        or any(_has_concrete(v) for v in kwargs.values())
+    ):
+        return args, kwargs
+    return tuple(lift(a) for a in args), {k: lift(v) for k, v in kwargs.items()}
+
+
+def _has_concrete(x) -> bool:
+    from thunder_tpu.executors import bridge
+
+    if isinstance(x, (list, tuple)):
+        return any(bridge.is_concrete_tensor(v) for v in x)
+    return bridge.is_concrete_tensor(x)
+
+
 class Symbol:
     def __init__(
         self,
@@ -106,6 +142,14 @@ class Symbol:
         # per-call proxy remap (tracing latency is a product metric).
         if getattr(trace, "_inplace_seen", False):
             args, kwargs = resolve_inplace_tree((args, kwargs))
+
+        # Concrete arrays reaching an op during tracing are CAPTURED
+        # constants (closures, globals, defaults — the VM's provenance
+        # cases, reference interpreter.py): lift them into the trace as
+        # baked tensor constants. Shallow + one container level covers the
+        # real call shapes (cat/stack lists); deeper nesting reaches a meta
+        # and fails loudly there.
+        args, kwargs = _lift_captured_tensors(args, kwargs)
 
         if self.is_prim:
             result = self.meta(*args, **kwargs)

@@ -36,11 +36,25 @@ def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
+def _ce_block_n(N: int, V: int):
+    """Row-block size for the CE kernels, or None when unclaimable.
+
+    The bwd kernel live-holds ~6 f32 (block, V) temporaries (x, e, p, iota,
+    onehot, out) in scoped VMEM; budget them under the 16 MB scoped limit
+    with headroom (r5: pythia's V=50304 at the old fixed block of 16
+    overflowed by 724 KB on the real chip — 'Ran out of memory in memory
+    space vmem')."""
+    for bt in (32, 16, 8):
+        if N % bt == 0 and 6 * bt * V * 4 <= 12 * 1024 * 1024:
+            return bt
+    return None
+
+
 def _ce_shapes_ok(input, target) -> bool:
     if len(getattr(input, "shape", ())) != 2:
         return False
     N, V = input.shape
-    return V % _LANE == 0 and N % _BLOCK_N == 0 and V * _BLOCK_N * 4 <= 8 * 1024 * 1024
+    return V % _LANE == 0 and _ce_block_n(int(N), int(V)) is not None
 
 
 def _ce_checker(input, target, weight=None, ignore_index=-100, reduction="mean", label_smoothing=0.0):
@@ -111,10 +125,11 @@ def _ce_call(kernel, out_lanes, out_dtype, logits, *extra):
     from jax.experimental.pallas import tpu as pltpu
 
     N, V = logits.shape
-    grid = (N // _BLOCK_N,)
-    in_specs = [pl.BlockSpec((_BLOCK_N, V), lambda i: (i, 0), memory_space=pltpu.VMEM)]
+    bn = _ce_block_n(int(N), int(V)) or _BLOCK_N
+    grid = (N // bn,)
+    in_specs = [pl.BlockSpec((bn, V), lambda i: (i, 0), memory_space=pltpu.VMEM)]
     for _ in extra:
-        in_specs.append(pl.BlockSpec((_BLOCK_N, _LANE), lambda i: (i, 0), memory_space=pltpu.VMEM))
+        in_specs.append(pl.BlockSpec((bn, _LANE), lambda i: (i, 0), memory_space=pltpu.VMEM))
     # Mosaic's index maths is 32-bit; scope out the runtime's x64 mode so the
     # grid index maps don't trace to i64 (which fails to legalize).
     with jax.enable_x64(False):
@@ -122,7 +137,7 @@ def _ce_call(kernel, out_lanes, out_dtype, logits, *extra):
             kernel,
             grid=grid,
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((_BLOCK_N, out_lanes), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            out_specs=pl.BlockSpec((bn, out_lanes), lambda i: (i, 0), memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((N, out_lanes), out_dtype),
             interpret=_interpret(),
         )(logits, *extra)

@@ -1073,6 +1073,24 @@ class ThunderModule:
                     entry = cand
                     break
         if entry is None:
+            # ADVICE r4: under dist shard_data the trace is acquired on
+            # placeholder batches — a model that branches on data CONTENTS
+            # bakes the placeholder's scalar into its value guards and every
+            # real batch misses, recompiling per step. Make the churn loud.
+            if entries and len(entries) >= 3 and not getattr(self, "_guard_churn_warned", False):
+                import warnings
+
+                warnings.warn(
+                    f"value guards missed {len(entries)} times for the same input "
+                    "metadata — the model likely branches on input values that "
+                    "differ every call (under a dist config, traces are acquired "
+                    "on placeholder batches, so data-dependent branches bake "
+                    "placeholder values). Each miss compiles a new entry; "
+                    "consider removing the data-dependent branch or passing "
+                    "shard_data=False in the dist config.",
+                    stacklevel=3,
+                )
+                self._guard_churn_warned = True
             cs.cache_misses += 1
             cs.last_trace_tracing_start = timer_ns()
             entry = self._compile(args, kwargs)

@@ -150,6 +150,7 @@ def _device_put_vjp(bsym, g):
 
 register_nondiff(
     PrimIDs.STOP_GRADIENT,
+    PrimIDs.TENSOR_CONSTANT,
     PrimIDs.ITEM,
     PrimIDs.FULL,
     PrimIDs.IOTA,
