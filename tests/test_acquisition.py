@@ -349,3 +349,22 @@ class TestCapturedTensorConstants:
         src = thunder_tpu.last_traces(jf)[0].python()
         assert src.count("tensor_constant") <= 2  # one bind line + maybe repr
         assert src.count("_tconst_") == 1, src
+
+    def test_captured_tensor_sharp_edge(self):
+        """Reference jit_ext.py:468: loading an unguardable tensor is a
+        sharp edge — error policy raises, warn policy warns, allow bakes."""
+        from thunder_tpu.common import ThunderSharpEdgeError
+
+        w = _r(3, seed=40)
+
+        def f(x):
+            return ttorch.sum(x * w)
+
+        with pytest.raises(ThunderSharpEdgeError, match="captured concrete tensor"):
+            thunder_tpu.jit(f, sharp_edges="error")(_r(3, seed=41))
+
+        with pytest.warns(UserWarning, match="captured concrete tensor"):
+            thunder_tpu.jit(f, sharp_edges="warn")(_r(3, seed=41))
+
+        # default allow: bakes silently (covered by the tests above)
+        assert np.isfinite(float(np.asarray(thunder_tpu.jit(f)(_r(3, seed=41)))))

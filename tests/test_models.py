@@ -127,7 +127,7 @@ class TestForwardParity:
 
 
 class TestTraining:
-    @pytest.mark.parametrize("name", ["gpt-tiny", "llama-tiny", "mixtral-tiny"])
+    @pytest.mark.parametrize("name", ["gpt-tiny", "llama-tiny", "mixtral-tiny", "falcon-tiny"])
     def test_sgd_reduces_loss(self, name):
         from thunder_tpu.core.pytree import tree_map
 

@@ -261,6 +261,59 @@ class TestHuggingFace:
             want = m(idx).logits
         np.testing.assert_allclose(got.detach().numpy(), want.detach().numpy(), rtol=1e-3, atol=1e-4)
 
+    def test_gpt2_forward_and_backward(self):
+        """HF GPT2 (ABSOLUTE position embeddings, LayerNorm, Conv1D-style
+        weights, tied lm_head) — a different acquisition surface than the
+        rope families; fwd parity + full param-grad parity (r5)."""
+        transformers = pytest.importorskip("transformers")
+        cfg = transformers.GPT2Config(vocab_size=64, n_positions=32, n_embd=32,
+                                      n_layer=2, n_head=4)
+        torch.manual_seed(3)
+        m_ref = transformers.GPT2LMHeadModel(cfg).eval()
+        m_jit = transformers.GPT2LMHeadModel(cfg).eval()
+        m_jit.load_state_dict(m_ref.state_dict())
+        tm = thunder_tpu.jit(m_jit)
+        idx = torch.from_numpy(np.random.RandomState(3).randint(0, 64, (2, 16)))
+        got = tm(idx)["logits"]
+        want = m_ref(idx).logits
+        np.testing.assert_allclose(got.detach().numpy(), want.detach().numpy(),
+                                   rtol=2e-3, atol=2e-3)
+        got.float().pow(2).mean().backward()
+        m_ref(idx).logits.float().pow(2).mean().backward()
+        checked = 0
+        for (n1, p1), (_, p2) in zip(m_jit.named_parameters(), m_ref.named_parameters()):
+            if p2.grad is None:
+                continue
+            assert p1.grad is not None, n1
+            np.testing.assert_allclose(p1.grad.numpy(), p2.grad.numpy(),
+                                       rtol=2e-2, atol=1e-4, err_msg=n1)
+            checked += 1
+        assert checked >= 10
+
+    def test_bert_encoder_with_attention_mask(self):
+        """HF BERT (bidirectional ENCODER: absolute+token-type embeddings,
+        additive attention-mask expansion via torch.finfo on a traced
+        dtype) — r5: the finfo/iinfo lookaside makes HF's mask utils trace."""
+        transformers = pytest.importorskip("transformers")
+        cfg = transformers.BertConfig(
+            vocab_size=64, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=32, type_vocab_size=2,
+        )
+        torch.manual_seed(4)
+        m = transformers.BertModel(cfg).eval()
+        tm = thunder_tpu.jit(m)
+        idx = torch.from_numpy(np.random.RandomState(4).randint(0, 64, (2, 12)))
+        mask = torch.ones(2, 12, dtype=torch.long)
+        mask[0, 8:] = 0  # right padding
+        got = tm(input_ids=idx, attention_mask=mask)["last_hidden_state"]
+        with torch.no_grad():
+            want = m(input_ids=idx, attention_mask=mask).last_hidden_state
+        valid = mask.bool().numpy()
+        np.testing.assert_allclose(
+            got.detach().numpy()[valid], want.numpy()[valid], rtol=2e-3, atol=2e-3
+        )
+
     def test_gptneox_backward(self):
         transformers = pytest.importorskip("transformers")
         cfg = transformers.GPTNeoXConfig(

@@ -389,8 +389,13 @@ def trace_program(
     plg = _build_prologue(args, kwargs, pristine_args, pristine_kwargs, tensor_leaves)
     # Concretization is only possible while the user function executes; drop
     # the concrete-input references so cached trace objects don't pin the
-    # first call's tensors (and params) for the process lifetime.
+    # first call's tensors (and params) for the process lifetime. Same for
+    # the tensor-constant memo: its id-reuse guard matters only WHILE
+    # tracing, and keeping it would pin every captured host tensor alongside
+    # the baked device copy for the cache entry's lifetime.
     comp_trc._concrete_leaves = None
+    if getattr(comp_trc, "_tconst_memo", None) is not None:
+        comp_trc._tconst_memo = None
     return plg, comp_trc
 
 

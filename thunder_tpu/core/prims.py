@@ -289,6 +289,14 @@ def _tensor_constant_printer(bsym) -> str:
 def _tensor_constant_bind(bsym) -> None:
     handle = bsym.args[0]
     bsym._call_ctx[f"_tconst_{id(handle)}"] = handle.value
+    # Provenance comment in the generated program (the VM records where
+    # every value was loaded from — interpreter.py provenance; here the
+    # trace documents what was captured).
+    v = handle.value
+    bsym.header = (
+        f"captured tensor constant: shape {tuple(getattr(v, 'shape', ()))} "
+        f"dtype {getattr(v, 'dtype', '?')} (baked; not a guarded input)"
+    )
 
 
 tensor_constant_sym = make_prim(
@@ -325,6 +333,17 @@ def tensor_constant(value):
     hit = memo.get(id(value))
     if hit is not None:
         return hit[1]
+    # The reference's global-load sharp edge (jit_ext.py:468): loading a
+    # tensor the prologue cannot guard is silent under "allow", loud under
+    # "warn"/"error" — the baked value goes stale if the caller mutates it.
+    from thunder_tpu.common import sharp_edge
+
+    sharp_edge(
+        f"captured concrete tensor (shape {tuple(getattr(value, 'shape', ()))}) "
+        "baked into the trace as a constant — it is not a guarded input; "
+        "later mutation of the captured array will NOT be seen. Pass it as "
+        "an argument to make it an input"
+    )
     proxy = tensor_constant_sym(_ConstHandle(bridge.to_jax(value)))
     # Keep the source object alive for the trace's lifetime so its id can't
     # be reused by a different array.

@@ -49,40 +49,51 @@ def resolve_inplace_tree(tree: Any) -> Any:
     return tree_unflatten(spec, [resolve_inplace(x) for x in flat])
 
 
+_is_concrete_tensor = None  # bound lazily: importing bridge at module load cycles
+
+
 def _lift_captured_tensors(args: tuple, kwargs: dict):
     """Replace concrete arrays (numpy/torch/jax) in a traced op's operands
     with baked tensor-constant proxies (prims.tensor_constant). Shallow +
-    one list/tuple level; no-op when nothing concrete is present."""
-    from thunder_tpu.executors import bridge
+    one list/tuple level; single pass, no-op (no allocation) when nothing
+    concrete is present — this sits on the tracing hot path."""
+    global _is_concrete_tensor
 
-    def lift_one(x):
-        if bridge.is_concrete_tensor(x):
+    ict = _is_concrete_tensor
+    if ict is None:
+        from thunder_tpu.executors.bridge import is_concrete_tensor as ict
+
+        _is_concrete_tensor = ict
+
+    def lift(x):
+        if ict(x):
             from thunder_tpu.core import prims
 
             return prims.tensor_constant(x)
+        if isinstance(x, (list, tuple)) and any(ict(v) for v in x):
+            from thunder_tpu.core import prims
+
+            return type(x)(
+                prims.tensor_constant(v) if ict(v) else v for v in x
+            )
         return x
 
-    def lift(x):
-        if isinstance(x, (list, tuple)) and any(
-            bridge.is_concrete_tensor(v) for v in x
-        ):
-            return type(x)(lift_one(v) for v in x)
-        return lift_one(x)
-
-    if not (
-        any(_has_concrete(a) for a in args)
-        or any(_has_concrete(v) for v in kwargs.values())
-    ):
+    new_args = None
+    for i, a in enumerate(args):
+        if ict(a) or (isinstance(a, (list, tuple)) and any(ict(v) for v in a)):
+            if new_args is None:
+                new_args = list(args)
+            new_args[i] = lift(a)
+    new_kwargs = None
+    for k, v in kwargs.items():
+        if ict(v) or (isinstance(v, (list, tuple)) and any(ict(u) for u in v)):
+            if new_kwargs is None:
+                new_kwargs = dict(kwargs)
+            new_kwargs[k] = lift(v)
+    if new_args is None and new_kwargs is None:
         return args, kwargs
-    return tuple(lift(a) for a in args), {k: lift(v) for k, v in kwargs.items()}
-
-
-def _has_concrete(x) -> bool:
-    from thunder_tpu.executors import bridge
-
-    if isinstance(x, (list, tuple)):
-        return any(bridge.is_concrete_tensor(v) for v in x)
-    return bridge.is_concrete_tensor(x)
+    return (tuple(new_args) if new_args is not None else args,
+            new_kwargs if new_kwargs is not None else kwargs)
 
 
 class Symbol:

@@ -125,7 +125,11 @@ def _ce_call(kernel, out_lanes, out_dtype, logits, *extra):
     from jax.experimental.pallas import tpu as pltpu
 
     N, V = logits.shape
-    bn = _ce_block_n(int(N), int(V)) or _BLOCK_N
+    bn = _ce_block_n(int(N), int(V))
+    assert bn is not None, (
+        f"CE kernel called with unclaimable shape ({N}, {V}) — the checker "
+        "must gate this (a floored grid would leave tail rows unwritten)"
+    )
     grid = (N // bn,)
     in_specs = [pl.BlockSpec((bn, V), lambda i: (i, 0), memory_space=pltpu.VMEM)]
     for _ in extra:

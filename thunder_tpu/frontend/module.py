@@ -211,6 +211,50 @@ class _library_lookasides:
         return False
 
 
+class _patched_dtype_introspection:
+    """Context: ``torch.finfo``/``torch.iinfo`` accept thunder dtypes.
+
+    HF mask utilities call ``torch.finfo(tensor.dtype)`` on values that are
+    TensorProxies during tracing (e.g. BERT's additive-mask expansion,
+    transformers/modeling_attn_mask_utils.py) — proxies carry thunder
+    dtypes, which stock finfo rejects. Translate before delegating."""
+
+    def __enter__(self):
+        import torch
+
+        from thunder_tpu.core import dtypes as _dt
+
+        self._orig = (torch.finfo, torch.iinfo)
+
+        def to_torch_dtype(x):
+            try:
+                return _dt.to_torch_dtype(_dt.to_dtype(x))
+            except Exception:
+                return x
+
+        orig_finfo, orig_iinfo = self._orig
+
+        class _Finfo:
+            def __new__(cls, dtype=None):
+                if dtype is None:  # stock semantics: finfo of the default dtype
+                    return orig_finfo()
+                return orig_finfo(to_torch_dtype(dtype))
+
+        class _Iinfo:
+            def __new__(cls, dtype):
+                return orig_iinfo(to_torch_dtype(dtype))
+
+        torch.finfo = _Finfo
+        torch.iinfo = _Iinfo
+        return self
+
+    def __exit__(self, *exc):
+        import torch
+
+        torch.finfo, torch.iinfo = self._orig
+        return False
+
+
 class _swapped_params:
     """Context: module params/buffers replaced by ``values[qual_name]``."""
 
@@ -587,7 +631,8 @@ class ThunderModule:
                         synced[qual] = p
                 params = synced
             with _swapped_params(module, params), _patched_module_setattr(), \
-                    _patched_factories(), _library_lookasides(), _make_dispatch_mode():
+                    _patched_factories(), _library_lookasides(), \
+                    _patched_dtype_introspection(), _make_dispatch_mode():
                 out = module(*fargs, **fkwargs)
                 # Epilogue diff (reference: jit_ext.py:1302
                 # `process_recorded_modifications`): any param/buffer whose

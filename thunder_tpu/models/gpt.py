@@ -134,6 +134,24 @@ _add(GPTConfig(name="mistral-7b", block_size=4096, vocab_size=32000, padded_voca
                parallel_residual=False, bias=False, norm_class="RMSNorm", norm_eps=1e-5,
                mlp_class="LLaMAMLP", intermediate_size=14336))
 
+# Falcon family — MQA (one KV head) + shared-attention-norm parallel residual
+# (the litgpt registry's falcon geometry; reference tests run falcon-7b-like
+# configs through thunder).
+_add(GPTConfig(name="falcon-7b", block_size=2048, vocab_size=65024, padded_vocab_size=65024,
+               n_layer=32, n_head=71, n_embd=4544, n_query_groups=1, rotary_percentage=1.0,
+               parallel_residual=True, shared_attention_norm=True, bias=False,
+               norm_class="LayerNorm", mlp_class="GptNeoxMLP", intermediate_size=18176))
+_add(GPTConfig(name="falcon-tiny", block_size=64, vocab_size=96, padded_vocab_size=96,
+               n_layer=2, n_head=4, n_embd=32, n_query_groups=1, rotary_percentage=1.0,
+               parallel_residual=True, shared_attention_norm=True, bias=False,
+               norm_class="LayerNorm", mlp_class="GptNeoxMLP", intermediate_size=128))
+
+# Phi-2 — partial-rotary parallel-residual with biases.
+_add(GPTConfig(name="phi-2", block_size=2048, vocab_size=50257, padded_vocab_size=51200,
+               n_layer=32, n_head=32, n_embd=2560, rotary_percentage=0.4,
+               parallel_residual=True, shared_attention_norm=True, bias=True,
+               norm_class="LayerNorm", mlp_class="GptNeoxMLP", intermediate_size=10240))
+
 
 def name_to_config(name: str) -> GPTConfig:
     return configs[name]
