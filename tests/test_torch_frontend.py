@@ -290,6 +290,25 @@ class TestHuggingFace:
             checked += 1
         assert checked >= 10
 
+    def test_t5_encoder_decoder(self):
+        """HF T5 (full ENCODER-DECODER: relative position bias via in-place
+        index writes, cross attention, _stacklevel softmax kwarg,
+        ModuleUtilsMixin.dtype over proxied params) — r5."""
+        transformers = pytest.importorskip("transformers")
+        cfg = transformers.T5Config(vocab_size=64, d_model=32, d_kv=8, d_ff=64,
+                                    num_layers=2, num_heads=4,
+                                    decoder_start_token_id=0)
+        torch.manual_seed(5)
+        m = transformers.T5ForConditionalGeneration(cfg).eval()
+        tm = thunder_tpu.jit(m)
+        enc = torch.from_numpy(np.random.RandomState(5).randint(0, 64, (2, 10)))
+        dec = torch.from_numpy(np.random.RandomState(6).randint(0, 64, (2, 6)))
+        got = tm(input_ids=enc, decoder_input_ids=dec)["logits"]
+        with torch.no_grad():
+            want = m(input_ids=enc, decoder_input_ids=dec).logits
+        np.testing.assert_allclose(got.detach().numpy(), want.numpy(),
+                                   rtol=2e-3, atol=2e-3)
+
     def test_bert_encoder_with_attention_mask(self):
         """HF BERT (bidirectional ENCODER: absolute+token-type embeddings,
         additive attention-mask expansion via torch.finfo on a traced

@@ -107,6 +107,7 @@ class PrimIDs(enum.Enum):
     SQUEEZE = enum.auto()
     TRANSPOSE = enum.auto()
     TAKE = enum.auto()
+    SETITEM = enum.auto()
     TAKE_ALONG_AXIS = enum.auto()
     GATHER = enum.auto()
     SCATTER_ADD = enum.auto()
@@ -897,6 +898,15 @@ def _transpose_meta(a: TensorProxy, permutation: Sequence[int]) -> TensorProxy:
 
 
 transpose = make_prim(PrimIDs.TRANSPOSE, "transpose", _transpose_meta, tags=(OpTags.SHAPE_OP,))
+
+
+def _setitem_meta(a: TensorProxy, key, value) -> TensorProxy:
+    """Out-of-place indexed update: a copy of ``a`` with ``a[key] = value``
+    applied (numpy/jax basic+advanced indexing semantics via .at[].set)."""
+    return TensorProxy(like=a)
+
+
+setitem = make_prim(PrimIDs.SETITEM, "setitem", _setitem_meta)
 
 
 def _take_meta(a: TensorProxy, indices: TensorProxy, dim: int) -> TensorProxy:

@@ -493,6 +493,16 @@ class TensorProxy(Proxy):
     def numel_(self) -> int:
         return self.numel
 
+    def is_floating_point(self) -> bool:
+        # torch.Tensor API used by HF's ModuleUtilsMixin.dtype (iterates
+        # parameters — TensorProxies while swapped in during tracing).
+        return dtypes.is_inexact_dtype(dtypes.to_dtype(self.dtype)) and not dtypes.is_complex_dtype(
+            dtypes.to_dtype(self.dtype)
+        )
+
+    def is_complex(self) -> bool:
+        return dtypes.is_complex_dtype(dtypes.to_dtype(self.dtype))
+
     def __bool__(self):
         return self._concretize("bool")
 
@@ -639,6 +649,11 @@ class TensorProxy(Proxy):
     # indexing
     def __getitem__(self, key):
         return self._dispatch("getitem", key)
+
+    def __setitem__(self, key, value):
+        # In-place indexed write: functionalizes via the setitem_ language
+        # method (out-of-place update + proxy forwarding).
+        self._dispatch("setitem_", key, value)
 
 
 class FutureTensorProxy(TensorProxy):

@@ -124,6 +124,16 @@ _reg(
         a, tuple(int(s) for s in starts), tuple(int(e) for e in ends), tuple(int(s) for s in strides) if strides else None
     ),
 )
+def _setitem(a, key, value):
+    # Explicit cast to the target dtype: torch setitem truncates (7.5 into
+    # an int32 tensor stores 7); jax's implicit unsafe-scatter cast is
+    # deprecated and will become an error.
+    return a.at[key].set(jnp.asarray(value, a.dtype))
+
+
+_reg(PrimIDs.SETITEM, _setitem)
+
+
 _reg(PrimIDs.SQUEEZE, lambda a, dims: lax.squeeze(a, tuple(dims)))
 _reg(PrimIDs.TRANSPOSE, lambda a, perm: lax.transpose(a, tuple(perm)))
 _reg(PrimIDs.TAKE, lambda a, idx, dim: jnp.take(a, idx, axis=dim))

@@ -452,6 +452,59 @@ def take_along_dim(a, indices, dim: int):
     return clang.take_along_axis(a, indices, int(pyval(dim)))
 
 
+def _normalize_index_key(key):
+    """pyval static ints (incl. inside slices); keep TensorProxy indices."""
+    def one(k):
+        if isinstance(k, slice):
+            return slice(one(k.start), one(k.stop), one(k.step))
+        from thunder_tpu.core.proxies import NumberProxy
+
+        if isinstance(k, NumberProxy):
+            return pyval(k)
+        return k
+
+    if isinstance(key, tuple):
+        return tuple(one(k) for k in key)
+    return one(key)
+
+
+@torchsymbol("torch.setitem", method_name="setitem")
+def setitem(a, key, value):
+    """Out-of-place ``a[key] = value`` (a copy with the update applied);
+    the in-place form functionalizes through ``TensorProxy.__setitem__``
+    (HF T5's relative-position bucketing writes slices in place).
+
+    Boolean-mask keys: ``a[mask] = scalar`` lowers to ``where`` (static
+    shapes — the jax scatter path would need concrete indices); a TENSOR
+    value under a boolean mask is data-dependently shaped and rejected
+    loudly."""
+    from thunder_tpu.core import dtypes as _dt
+
+    keys = key if isinstance(key, tuple) else (key,)
+    bool_masks = [
+        k for k in keys
+        if isinstance(k, TensorProxy) and _dt.is_boolean_dtype(_dt.to_dtype(k.dtype))
+    ]
+    if bool_masks:
+        if len(keys) == 1 and not isinstance(value, TensorProxy):
+            mask = bool_masks[0]
+            # torch aligns mask dims with a's LEADING dims; expand trailing.
+            while mask.ndim < a.ndim:
+                mask = unsqueeze(mask, mask.ndim)
+            fill = clang.full((), pyval(value), device=a.device, dtype=a.dtype)
+            return clang.where(mask, fill, a)
+        raise NotImplementedError(
+            "setitem with a boolean mask and a tensor value (or a mask "
+            "inside a tuple key) is data-dependently shaped; use "
+            "masked_fill / torch.where, or index with integer tensors"
+        )
+    if isinstance(value, TensorProxy):
+        value = clang.maybe_convert_to_dtype(value, a.dtype)
+    else:
+        value = pyval(value)
+    return prims.setitem(a, _normalize_index_key(key), value)
+
+
 @torchsymbol("torch.index_put", method_name="index_put")
 def index_put(a, indices, values, accumulate: bool = False):
     return clang.index_put(a, indices, values, accumulate)
@@ -760,7 +813,9 @@ def hardswish(a, inplace: bool = False):
 
 
 @torchsymbol("torch.softmax", "torch.nn.functional.softmax", method_name="softmax")
-def softmax(a, dim: int, dtype=None):
+def softmax(a, dim: int, dtype=None, _stacklevel=3):
+    # _stacklevel: torch-internal deprecation-warning plumbing
+    # (F.softmax passes it through HF's T5 attention); accepted + ignored.
     d = canonicalize_dim(a.ndim, int(pyval(dim)))
     if dtype is not None:
         a = clang.maybe_convert_to_dtype(a, to_dtype(dtype))
@@ -770,7 +825,7 @@ def softmax(a, dim: int, dtype=None):
 
 
 @torchsymbol("torch.log_softmax", "torch.nn.functional.log_softmax", method_name="log_softmax")
-def log_softmax(a, dim: int, dtype=None):
+def log_softmax(a, dim: int, dtype=None, _stacklevel=3):
     d = canonicalize_dim(a.ndim, int(pyval(dim)))
     if dtype is not None:
         a = clang.maybe_convert_to_dtype(a, to_dtype(dtype))
@@ -2509,6 +2564,7 @@ erf_ = _inplace("erf_", clang.erf)
 zero_ = _inplace("zero_", lambda a: clang.zeros_like(a))
 fill_ = _inplace("fill_", lambda a, v: clang.full_like(a, v))
 masked_fill_ = _inplace("masked_fill_", masked_fill)
+setitem_ = _inplace("setitem_", setitem)
 clamp_ = _inplace("clamp_", clang.clamp)
 clamp_min_ = _inplace("clamp_min_", lambda a, m: clang.clamp(a, m, None))
 clamp_max_ = _inplace("clamp_max_", lambda a, m: clang.clamp(a, None, m))

@@ -443,6 +443,16 @@ def _pad_vjp(bsym, g):
     return (prims.slice_prim(g, starts, ends, strides), None, None)
 
 
+@register_vjp(PrimIDs.SETITEM)
+def _setitem_vjp(bsym, g):
+    a, key, value = bsym.args
+    ga = prims.setitem(g, key, 0.0) if _is_float_tensor(a) else None
+    gv = None
+    if isinstance(value, TensorProxy) and _is_float_tensor(value):
+        gv = clang.getitem(g, key)  # _unbroadcast handles value broadcasting
+    return (ga, None, gv)
+
+
 @register_vjp(PrimIDs.TAKE)
 def _take_vjp(bsym, g):
     a, idx, dim = bsym.args
