@@ -406,3 +406,76 @@ class TestPlainTorchFunctions:
         got = thunder_tpu.jit(f)(x)
         want = (torch.tanh(x) * F.relu(x)).sum()
         torch.testing.assert_close(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_no_grad_and_frozen_params(self):
+        """torch.no_grad() inside forward + requires_grad_(False) params:
+        a TRAINABLE param used only under no_grad gets no grad (matching
+        eager), frozen params get none, trained ones match eager."""
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+                self.aux = nn.Linear(4, 4)       # trainable, used ONLY under no_grad
+                self.frozen = nn.Linear(4, 4)
+                self.frozen.requires_grad_(False)
+
+            def forward(self, x):
+                with torch.no_grad():
+                    base = self.frozen(x) + self.aux(x)
+                return (self.fc(x) + base).sum()
+
+        torch.manual_seed(6)
+        m_ref = M()
+        m_jit = M()
+        m_jit.load_state_dict(m_ref.state_dict())
+        tm = thunder_tpu.jit(m_jit)
+        x = torch.randn(3, 4)
+        out = tm(x)
+        torch.testing.assert_close(out, m_ref(x), rtol=1e-3, atol=1e-5)
+        out.backward()
+        m_ref(x).backward()
+        torch.testing.assert_close(m_jit.fc.weight.grad, m_ref.fc.weight.grad,
+                                   rtol=1e-3, atol=1e-5)
+        assert m_jit.frozen.weight.grad is None
+        # the non-vacuous no_grad check: aux is trainable but detached by
+        # the block — eager leaves its grad None and so must the jit
+        assert m_ref.aux.weight.grad is None
+        g = m_jit.aux.weight.grad
+        assert g is None or float(g.abs().max()) == 0.0, g
+
+    def test_grad_mode_forms(self):
+        """set_grad_enabled statement form, bare @torch.no_grad decorator,
+        and is_grad_enabled all honor the trace-level flag (r5 review)."""
+        class M(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+                self.aux = nn.Linear(4, 4)
+
+            @torch.no_grad
+            def frozen_path(self, x):
+                return self.aux(x)
+
+            def forward(self, x):
+                assert torch.is_grad_enabled()
+                torch.set_grad_enabled(False)
+                assert not torch.is_grad_enabled()
+                base = self.aux(x)
+                torch.set_grad_enabled(True)
+                return (self.fc(x) + base + self.frozen_path(x)).sum()
+
+        torch.manual_seed(7)
+        m_ref = M()
+        m_jit = M()
+        m_jit.load_state_dict(m_ref.state_dict())
+        tm = thunder_tpu.jit(m_jit)
+        x = torch.randn(3, 4)
+        out = tm(x)
+        torch.testing.assert_close(out, m_ref(x), rtol=1e-3, atol=1e-5)
+        out.backward()
+        m_ref(x).backward()
+        torch.testing.assert_close(m_jit.fc.weight.grad, m_ref.fc.weight.grad,
+                                   rtol=1e-3, atol=1e-5)
+        assert m_ref.aux.weight.grad is None
+        g = m_jit.aux.weight.grad
+        assert g is None or float(g.abs().max()) == 0.0, g

@@ -49,6 +49,20 @@ def resolve_inplace_tree(tree: Any) -> Any:
     return tree_unflatten(spec, [resolve_inplace(x) for x in flat])
 
 
+def _detach_tree(result):
+    """stop_gradient over every tensor proxy in an op result (no_grad)."""
+    from thunder_tpu.core import prims
+    from thunder_tpu.core.baseutils import ProxyInterface
+
+    def detach(x):
+        if isinstance(x, ProxyInterface) and hasattr(x, "dtype") and hasattr(x, "shape"):
+            return prims.stop_gradient(x)
+        return x
+
+    flat, spec = tree_flatten(result)
+    return tree_unflatten(spec, [detach(x) for x in flat])
+
+
 _is_concrete_tensor = None  # bound lazily: importing bridge at module load cycles
 
 
@@ -175,6 +189,19 @@ class Symbol:
 
         bsym = self.bind(*args, output=result, subsymbols=tuple(subsymbols), **kwargs)
         trace.add_bound_symbol(bsym)
+
+        # torch.no_grad during acquisition (frontend/sharp.py toggles the
+        # flag): detach this op's tensor outputs so nothing computed under
+        # the block contributes gradients — applied at the TOP scope only
+        # (composites wrap once, their subsymbols don't).
+        from thunder_tpu.core.trace import _grad_mode_ctx
+
+        if (
+            not _grad_mode_ctx.get()
+            and self.name != "stop_gradient"
+            and len(trace._scopes) == 1
+        ):
+            result = _detach_tree(result)
         return result
 
     def bind(self, *args, output: Any, subsymbols: tuple = (), **kwargs) -> "BoundSymbol":

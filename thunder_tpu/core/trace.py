@@ -187,6 +187,11 @@ def from_trace(trc: TraceCtx) -> TraceCtx:
 
 _tracectx = contextvars.ContextVar("tracectx", default=None)
 
+# Trace-level grad mode (torch.no_grad/enable_grad during acquisition):
+# False ⇒ Symbol.__call__ detaches op outputs via stop_gradient, matching
+# eager's "values computed under no_grad are leaves" semantics.
+_grad_mode_ctx = contextvars.ContextVar("trace_grad_mode", default=True)
+
 
 def get_tracectx() -> Optional[TraceCtx]:
     return _tracectx.get()

@@ -107,7 +107,98 @@ def sharp_edge_interceptors():
             if fn is not None:
                 patch(time, fn_name, _reporting("time", fn_name, fn))
         patch(os, "environ", _ReportingEnviron(os.environ))
+        grad_tok = None
+        try:
+            import torch
+
+            # Grad-mode contexts: torch's autograd flag means nothing to
+            # the tracer, so no_grad/enable_grad/set_grad_enabled ALSO
+            # toggle the trace-level flag — Symbol.__call__ stop_gradients
+            # op outputs while disabled (eager parity: values computed
+            # under no_grad are detached). The REAL torch context is still
+            # entered alongside, so concrete (non-proxy) tensor work under
+            # the block keeps eager autograd behavior.
+            from thunder_tpu.core.trace import _grad_mode_ctx
+
+            real_no_grad = torch.no_grad
+            real_enable_grad = torch.enable_grad
+            real_grad_state = torch.is_grad_enabled()
+            grad_tok = _grad_mode_ctx.set(_grad_mode_ctx.get())  # restore point
+
+            class _GradMode:
+                def __init__(self, mode: bool):
+                    self._mode = mode
+                    self._real = (real_enable_grad if mode else real_no_grad)()
+
+                def __enter__(self):
+                    self._tok = _grad_mode_ctx.set(self._mode)
+                    self._real.__enter__()
+                    return self
+
+                def __exit__(self, *exc):
+                    self._real.__exit__(*exc)
+                    _grad_mode_ctx.reset(self._tok)
+                    return False
+
+                def _wrap(self, fn):
+                    import functools
+
+                    mode = self._mode
+
+                    @functools.wraps(fn)
+                    def wrapped(*a, **kw):
+                        with _GradMode(mode):
+                            return fn(*a, **kw)
+
+                    return wrapped
+
+                def __call__(self, fn):  # decorator form with parentheses
+                    return self._wrap(fn)
+
+            def _factory(mode):
+                # torch.no_grad works as @torch.no_grad (bare), @torch.no_grad()
+                # and `with torch.no_grad():` — accept all three shapes.
+                def make(fn=None):
+                    if callable(fn):
+                        return _GradMode(mode)._wrap(fn)
+                    return _GradMode(mode)
+
+                return make
+
+            class _SetGradEnabled:
+                """torch.set_grad_enabled: takes effect IMMEDIATELY at call
+                (statement form) and restores on __exit__ (with form)."""
+
+                def __init__(self, mode):
+                    self._tok = _grad_mode_ctx.set(bool(mode))
+                    torch._C._set_grad_enabled(bool(mode))
+
+                def __enter__(self):
+                    return self
+
+                def __exit__(self, *exc):
+                    _grad_mode_ctx.reset(self._tok)
+                    torch._C._set_grad_enabled(_grad_mode_ctx.get())
+                    return False
+
+            patch(torch, "no_grad", _factory(False))
+            patch(torch, "enable_grad", _factory(True))
+            patch(torch, "set_grad_enabled", _SetGradEnabled)
+            patch(torch, "inference_mode",
+                  lambda mode=True: (_GradMode(not mode)._wrap(mode) if callable(mode)
+                                     else _GradMode(not bool(mode))))
+            patch(torch, "is_grad_enabled", lambda: _grad_mode_ctx.get())
+            if hasattr(torch, "is_inference_mode_enabled"):
+                patch(torch, "is_inference_mode_enabled",
+                      lambda: not _grad_mode_ctx.get())
+        except ImportError:
+            pass
         yield
     finally:
         for obj, name, orig in reversed(saved):
             setattr(obj, name, orig)
+        if grad_tok is not None:
+            _grad_mode_ctx.reset(grad_tok)
+            import torch as _t
+
+            _t._C._set_grad_enabled(real_grad_state)
