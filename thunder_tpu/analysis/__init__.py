@@ -1,0 +1,86 @@
+"""Static trace verification and linting.
+
+A rule-based verifier over :class:`~thunder_tpu.core.trace.TraceCtx`: the
+trace is walked once into a :class:`VerifyContext` and a registry of named
+rules checks the invariants every transform pass must preserve —
+
+- ``ssa.*``           def-use discipline (use-before-def, redefinition, live outputs)
+- ``meta.*``          output shape/dtype/device vs re-running the prim's meta
+- ``alias.*``         in-place ops whose destination is still consumed later
+- ``dce.*``           side-effect-free symbols with no consumers
+- ``names.*``         name-registry hygiene
+- ``dist.*``          collective mesh-axis/group consistency, future/wait pairing,
+                      fw/bw collective balance
+
+Pipeline wiring: with ``THUNDER_TPU_CHECKS=1`` (or ``jit(debug_checks=True)``)
+every pass's ``wrap_in_trace_provenance``/``mark`` runs :func:`verify_or_raise`
+on its output, attributing the first failing diagnostic to the pass that
+introduced it. User-facing: ``thunder_tpu.examine.lint(fn, *args)``.
+
+Docs: docs/trace_invariants.md lists every rule id and the suppression and
+extension (``register_rule``) story.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from thunder_tpu.analysis.diagnostics import (  # noqa: F401
+    Diagnostic,
+    Severity,
+    TraceVerificationError,
+    attach_trace_lines,
+    max_severity,
+)
+from thunder_tpu.analysis.context import VerifyContext, pass_name_of  # noqa: F401
+from thunder_tpu.analysis.registry import (  # noqa: F401
+    Rule,
+    all_rules,
+    enabled_rules,
+    get_rule,
+    register_rule,
+    set_rule_enabled,
+)
+from thunder_tpu.core.trace import TraceCtx, tracectx
+
+
+def verify(
+    trace: TraceCtx,
+    *,
+    pass_name: Optional[str] = None,
+    disable: Iterable[str] = (),
+    with_trace_lines: bool = False,
+) -> list[Diagnostic]:
+    """Run every enabled rule over ``trace``; return structured diagnostics.
+
+    ``pass_name`` overrides the provenance-derived attribution. ``disable``
+    suppresses rule ids (both rule execution and their findings). Rules run
+    under a detached (None) trace context so meta re-runs can never record
+    into, or mint names in, a live trace.
+    """
+    off = set(disable)
+    ctx = VerifyContext(trace, pass_name=pass_name)
+    with tracectx(None):
+        for rule in enabled_rules(disable=off):
+            rule.fn(ctx)
+    diags = [d for d in ctx.diagnostics if d.rule not in off]
+    if with_trace_lines:
+        attach_trace_lines(diags, trace)
+    return diags
+
+
+def verify_or_raise(
+    trace: TraceCtx,
+    *,
+    pass_name: Optional[str] = None,
+    disable: Iterable[str] = (),
+    min_severity: Severity = Severity.ERROR,
+) -> list[Diagnostic]:
+    """Verify ``trace``; raise :class:`TraceVerificationError` if any
+    diagnostic reaches ``min_severity``. Returns the (sub-threshold)
+    diagnostics otherwise, so callers can surface warnings."""
+    diags = verify(trace, pass_name=pass_name, disable=disable, with_trace_lines=True)
+    failing = [d for d in diags if d.severity >= min_severity]
+    if failing:
+        raise TraceVerificationError(diags, pass_name=pass_name or pass_name_of(trace))
+    return diags

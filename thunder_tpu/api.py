@@ -417,11 +417,28 @@ def _has_tag_in_trace(trc: TraceCtx, tag: OpTags) -> bool:
 
 
 def _compile_entry(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> CacheEntry:
+    # debug_checks=True/False scopes the trace verifier (analysis/) over the
+    # whole pass pipeline; None defers to THUNDER_TPU_CHECKS. Each pass's
+    # provenance stamping (wrap_in_trace_provenance/mark in core/trace.py)
+    # verifies its output, so a violation names the pass that introduced it.
+    from thunder_tpu.core.trace import debug_checks
+
+    with debug_checks(cd.compile_options.get("debug_checks")):
+        return _compile_entry_checked(cd, cs, args, kwargs)
+
+
+def _compile_entry_checked(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> CacheEntry:
     import jax
+
+    from thunder_tpu.core.trace import mark
 
     cs.last_trace_tracing_start = timer_ns()
     with sharp_edges_policy(cd.sharp_edges):
         plg_trc, comp_trc = trace_program(cd.fn, args, kwargs, record_input_mutations=True)
+    # Stamp (and, under debug checks, verify) the freshly acquired traces so
+    # an acquisition bug is attributed to acquisition, not the first pass.
+    mark(comp_trc, "Acquisition")
+    mark(plg_trc, "Prologue construction")
     cs.last_trace_tracing_stop = timer_ns()
 
     input_mutations = getattr(comp_trc, "_input_mutations", None) or []
@@ -685,6 +702,7 @@ def jit(
     cache: str | CACHE_OPTIONS = CACHE_OPTIONS.CONSTANT_VALUES,
     sharp_edges: str | SHARP_EDGES_OPTIONS = SHARP_EDGES_OPTIONS.ALLOW,
     disable_jit_staging: bool = False,
+    debug_checks: Optional[bool] = None,
     **compile_options,
 ) -> Callable:
     """Compile ``fn`` for TPU execution (reference: thunder/__init__.py `jit:299`).
@@ -692,6 +710,11 @@ def jit(
     ``fn`` may be written against thunder_tpu's torch-mirror language, be a
     real ``torch.nn.Module``/torch function (acquired via the torch
     frontend), or operate on jax/numpy arrays directly.
+
+    ``debug_checks=True`` runs the static trace verifier (thunder_tpu/analysis)
+    after every transform pass, raising ``TraceVerificationError`` attributed
+    to the pass that broke an invariant; ``False`` disables it; ``None``
+    (default) defers to the ``THUNDER_TPU_CHECKS`` environment variable.
     """
     if fn is None:
         return functools.partial(
@@ -700,6 +723,7 @@ def jit(
             cache=cache,
             sharp_edges=sharp_edges,
             disable_jit_staging=disable_jit_staging,
+            debug_checks=debug_checks,
             **compile_options,
         )
 
@@ -726,7 +750,8 @@ def jit(
 
         return thunder_module(
             fn, executors=executors, cache=cache, sharp_edges=sharp_edges,
-            disable_jit_staging=disable_jit_staging, **compile_options
+            disable_jit_staging=disable_jit_staging, debug_checks=debug_checks,
+            **compile_options
         )
 
     cd = CompileData(
@@ -735,7 +760,7 @@ def jit(
         cache_option=resolve_cache_option(cache),
         sharp_edges=resolve_sharp_edges_option(sharp_edges),
         disable_jit_staging=disable_jit_staging,
-        compile_options=dict(compile_options),
+        compile_options=dict(compile_options, debug_checks=debug_checks),
     )
     cs = CompileStats()
 

@@ -61,6 +61,11 @@ class OpTags(enum.Enum):
     UNPACK_OP = enum.auto()
     GUARD_OP = enum.auto()
     COMM_OP = enum.auto()
+    # Observable-effect tags: the single source of truth shared by DCE
+    # (transforms/common.py), del_last_used, and the analysis/ verifier's
+    # dce.dead-symbol and alias.inplace-hazard rules.
+    SIDE_EFFECT = enum.auto()  # op does I/O or otherwise acts beyond its outputs
+    IN_PLACE = enum.auto()  # op mutates an operand (see analysis.rules.INPLACE_MUTATED_ARG)
 
 
 class PrimIDs(enum.Enum):
@@ -644,7 +649,7 @@ python_print = make_prim(
     PrimIDs.PRINT,
     "python_print",
     _print_meta,
-    tags=(OpTags.DONT_DCE,),
+    tags=(OpTags.DONT_DCE, OpTags.SIDE_EFFECT),
     python_impl=print,
 )
 
@@ -705,7 +710,12 @@ def _copy__meta(src: TensorProxy, dst: TensorProxy) -> TensorProxy:
     return TensorProxy(like=dst)
 
 
-copy_ = make_prim(PrimIDs.COPY_, "copy_", _copy__meta)
+# IN_PLACE: writes into ``dst`` — the verifier flags any later consumer of the
+# pre-mutation value; SIDE_EFFECT: the write is observable beyond the output,
+# so DCE must keep it even when the returned proxy goes unused.
+copy_ = make_prim(
+    PrimIDs.COPY_, "copy_", _copy__meta, tags=(OpTags.IN_PLACE, OpTags.SIDE_EFFECT)
+)
 
 
 # =============================================================================

@@ -245,6 +245,11 @@ class BoundSymbol(baseutils.BoundSymbolInterface):
         self._call_ctx: dict[str, Any] = {}
         self.header: str = ""
 
+    # -- tags ----------------------------------------------------------------
+
+    def has_tag(self, tag: Any) -> bool:
+        return tag in self.sym.tags
+
     # -- flattening ----------------------------------------------------------
 
     @property

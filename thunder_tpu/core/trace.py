@@ -60,6 +60,14 @@ class TraceCtx:
                 return name
 
     def add_name(self, name: str) -> None:
+        # Strict: the trace IR is SSA, so a name registered twice means two
+        # proxies would alias one name — the verifier's ssa rules depend on
+        # registration being unique (reference: trace.py add_name raises too).
+        check(
+            name not in self._names,
+            lambda: f"Name {name!r} is already registered in this trace",
+            ValueError,
+        )
         self._names.add(name)
 
     def has_name(self, name: str) -> bool:
@@ -222,12 +230,55 @@ def detached_trace():
         yield trace
 
 
+# -- debug checks (the trace verifier's pipeline hook) ------------------------
+#
+# Every pass stamps provenance through wrap_in_trace_provenance/mark; with
+# checks enabled, that stamping point ALSO runs the static verifier
+# (thunder_tpu/analysis) on the pass output, so the first malformed trace is
+# attributed to the pass that introduced it instead of surfacing as a cryptic
+# codegen or runtime failure. Enabled per-compile via jit(debug_checks=True)
+# (the contextvar) or process-wide via THUNDER_TPU_CHECKS=1.
+
+_debug_checks_ctx = contextvars.ContextVar("trace_debug_checks", default=None)
+
+
+def debug_checks_enabled() -> bool:
+    v = _debug_checks_ctx.get()
+    if v is not None:
+        return v
+    import os
+
+    return os.environ.get("THUNDER_TPU_CHECKS", "").strip().lower() not in ("", "0", "false", "off")
+
+
+@contextmanager
+def debug_checks(enabled: Optional[bool]):
+    """Scope the verifier on (True) or off (False); None defers to the
+    enclosing scope / THUNDER_TPU_CHECKS environment variable."""
+    if enabled is None:
+        yield
+        return
+    tok = _debug_checks_ctx.set(bool(enabled))
+    try:
+        yield
+    finally:
+        _debug_checks_ctx.reset(tok)
+
+
+def _maybe_verify(trc: TraceCtx) -> TraceCtx:
+    if debug_checks_enabled():
+        from thunder_tpu.analysis import verify_or_raise
+
+        verify_or_raise(trc)
+    return trc
+
+
 def wrap_in_trace_provenance(trc: TraceCtx, pass_name: str, start_ns: int) -> TraceCtx:
     elapsed_ms = (time.perf_counter_ns() - start_ns) / 1e6
     trc.provenance = TraceProvenance(f"{pass_name} (took {elapsed_ms:.2f} ms)")
-    return trc
+    return _maybe_verify(trc)
 
 
 def mark(trc: TraceCtx, pass_name: str) -> TraceCtx:
     trc.provenance = TraceProvenance(pass_name)
-    return trc
+    return _maybe_verify(trc)

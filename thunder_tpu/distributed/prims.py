@@ -41,7 +41,12 @@ _dist_syms: dict[DistOpIDs, Symbol] = {}
 
 
 def _make(id: DistOpIDs, name: str, meta) -> Symbol:
-    sym = Symbol(name, meta, id=id, is_prim=True, module="dist_prims")
+    from thunder_tpu.core.prims import OpTags
+
+    # COMM_OP marks the symbol as a collective for trace analyses (the
+    # analysis/ verifier's dist.* rules key on the DistOpIDs themselves, but
+    # the tag lets generic passes treat collectives uniformly).
+    sym = Symbol(name, meta, id=id, is_prim=True, module="dist_prims", tags=(OpTags.COMM_OP,))
     _dist_syms[id] = sym
     return sym
 

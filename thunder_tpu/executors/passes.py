@@ -59,10 +59,14 @@ def transform_for_execution(trace: TraceCtx, executors_list: Sequence[Executor])
             # Host-side op with an inline implementation (guards etc.)
             new_bsyms.append(bsym)
             return
-        if not bsym.subsymbols:
+        if not bsym.subsymbols and not (
+            bsym.has_tag(OpTags.SIDE_EFFECT) or bsym.has_tag(OpTags.DONT_DCE)
+        ):
             # A composite whose decomposition recorded nothing is an identity
             # (e.g. ``x[...]`` with full slices, dropout(p=0)): its outputs
-            # ARE its input proxies, so the op can simply be dropped.
+            # ARE its input proxies, so the op can simply be dropped — unless
+            # it is tagged effectful, in which case dropping it would erase an
+            # observable action (the verifier/DCE share this tag model).
             arg_vars = {variableify(p) for p in bsym.flat_proxy_args}
             if all(variableify(o) in arg_vars for o in bsym.flat_proxy_outs):
                 return

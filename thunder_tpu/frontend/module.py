@@ -487,6 +487,16 @@ class ThunderModule:
     # -- compilation ----------------------------------------------------------
 
     def _compile(self, args: tuple, kwargs: dict, _force_replicated_data: bool = False) -> dict:
+        # Scope the trace verifier over this compile: every pass below stamps
+        # provenance through wrap_in_trace_provenance/mark, which runs the
+        # analysis/ rules when checks are on (jit(debug_checks=True) or
+        # THUNDER_TPU_CHECKS=1).
+        from thunder_tpu.core.trace import debug_checks
+
+        with debug_checks(self._jit_options.get("debug_checks")):
+            return self._compile_checked(args, kwargs, _force_replicated_data)
+
+    def _compile_checked(self, args: tuple, kwargs: dict, _force_replicated_data: bool = False) -> dict:
         import jax
 
         from thunder_tpu.api import trace_program

@@ -62,6 +62,15 @@ def autocast(trace: TraceCtx, dtype=dtypes.bfloat16) -> TraceCtx:
                 old_outs = b.flat_proxy_outs
                 new_outs, _ = tree_flatten(out)
                 for o, n in zip(old_outs, [x for x in new_outs if isinstance(x, TensorProxy)]):
+                    # Cast the low-precision result back to the op's original
+                    # output dtype: consumers were recorded against that
+                    # metadata, and swapping a bf16 proxy into them would make
+                    # every downstream bsym's recorded dtype a lie (caught by
+                    # the verifier's meta.mismatch rule). The matmul itself
+                    # still runs on the MXU in ``dtype``; XLA fuses the
+                    # widening convert into the epilogue.
+                    if isinstance(o, TensorProxy) and n.dtype != o.dtype:
+                        n = clang.maybe_convert_to_dtype(n, o.dtype)
                     swap[variableify(o)] = n
             else:
                 ntrace.bound_symbols.append(b)

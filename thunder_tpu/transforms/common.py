@@ -19,7 +19,7 @@ from thunder_tpu.core.trace import TraceCtx, from_trace, tracectx, wrap_in_trace
 
 
 def has_tag(bsym: BoundSymbol, tag: OpTags) -> bool:
-    return tag in bsym.sym.tags
+    return bsym.has_tag(tag)
 
 
 def dce(trace: TraceCtx, keep: Sequence[Proxy] = ()) -> TraceCtx:
@@ -34,7 +34,10 @@ def dce(trace: TraceCtx, keep: Sequence[Proxy] = ()) -> TraceCtx:
 
     new_bsyms: list[BoundSymbol] = []
     for bsym in reversed(trace.bound_symbols):
-        keep_bsym = has_tag(bsym, OpTags.DONT_DCE)
+        # SIDE_EFFECT ops act beyond their outputs (I/O, in-place writes) and
+        # must survive even when nothing consumes their result — the same tag
+        # the verifier's dce.dead-symbol rule keys on (one source of truth).
+        keep_bsym = has_tag(bsym, OpTags.DONT_DCE) or has_tag(bsym, OpTags.SIDE_EFFECT)
         if not keep_bsym:
             keep_bsym = any(variableify(o) in needed for o in bsym.flat_proxy_outs)
         if keep_bsym:
@@ -57,7 +60,16 @@ def cse(trace: TraceCtx) -> TraceCtx:
 
     for bsym in trace.bound_symbols:
         bsym = bsym.from_bsym_swap_proxies(swap_map, skip_output=True)
-        if has_tag(bsym, OpTags.RANDOM_OP) or has_tag(bsym, OpTags.DONT_DCE) or not bsym.flat_proxy_outs:
+        # Effectful ops (SIDE_EFFECT/IN_PLACE) must never be merged: two
+        # identical copy_ calls are two observable writes, not one value —
+        # same tag model as DCE and the verifier's dce.dead-symbol rule.
+        if (
+            has_tag(bsym, OpTags.RANDOM_OP)
+            or has_tag(bsym, OpTags.DONT_DCE)
+            or has_tag(bsym, OpTags.SIDE_EFFECT)
+            or has_tag(bsym, OpTags.IN_PLACE)
+            or not bsym.flat_proxy_outs
+        ):
             new_bsyms.append(bsym)
             continue
         rhs = bsym.rhs
