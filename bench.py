@@ -276,6 +276,37 @@ def _bench_train():
     return avg, synced_avg, strict_avg, total, trace_s, compile_s
 
 
+def _bench_cache():
+    """Dispatch-path microbench: recompiles under bucketed symbolic caching
+    and the warm O(1) lookup cost (ISSUE 2 observability — the driver's JSON
+    line now tracks recompile storms and dispatch latency directly)."""
+    import thunder_tpu as ttpu
+    import thunder_tpu.clang as clang
+
+    def f(x):
+        return clang.sum(clang.tanh(x))
+
+    jf = ttpu.jit(f, cache="symbolic values", executors=["jax"],
+                  symbolic_dims={0: (0,)}, buckets={"batch": "pow2"})
+    xs = {b: np.ones((b, 64), np.float32) for b in range(1, 9)}
+    for b, x in xs.items():  # 8 batch sizes → one compile per pow2 bucket
+        jf(x)
+    for b, x in xs.items():  # warm sweep: learns every O(1) key
+        jf(x)
+
+    cs = ttpu.compile_stats(jf)
+    n_warm = 200
+    lookup_ns0 = cs.cache_lookup_ns
+    for _ in range(n_warm):
+        jf(xs[8])
+    lookup_us = (cs.cache_lookup_ns - lookup_ns0) / 1e3 / n_warm
+    info = ttpu.cache_info(jf)
+    print(f"# cache: {info['compiles']} compiles for 8 batch sizes, "
+          f"{info['fast_hits']} O(1) hits, warm lookup {lookup_us:.1f}us",
+          file=sys.stderr)
+    return info["recompiles"], lookup_us
+
+
 def _tpu_peak_tflops() -> float:
     import os
 
@@ -295,6 +326,7 @@ def main() -> None:
     from thunder_tpu.api import _ensure_runtime
 
     _ensure_runtime()  # torch-faithful dtypes + persistent XLA compile cache
+    recompile_count, lookup_us = _bench_cache()
     fwd_avg, fwd_trace_s, fwd_compile_s = _bench_forward()
     (train_avg, train_synced, train_strict, train_total,
      train_trace_s, train_compile_s) = _bench_train()
@@ -339,6 +371,10 @@ def main() -> None:
         "fwd_xla_compile_s": round(fwd_compile_s, 1),
         "train_trace_claim_s": round(train_trace_s, 1),
         "train_xla_compile_s": round(train_compile_s, 1),
+        # Dispatch-path health (cache="symbolic values" over 8 batch sizes):
+        # recompiles per sweep and the warm O(1) cache lookup cost.
+        "recompile_count": recompile_count,
+        "trace_cache_lookup_us": round(lookup_us, 1),
     }))
 
 

@@ -222,6 +222,10 @@ def test_prologue_bug_propagates():
     import dataclasses
 
     cs.cache_entries[0] = dataclasses.replace(cs.cache_entries[0], prologue_fn=broken_prologue)
+    # The O(1) fast path would skip the prologue for an already-learned key;
+    # clear it so the call goes through the prologue-probing slow path, which
+    # is where the propagate-don't-swallow contract lives.
+    cs.fast_cache.clear()
     with pytest.raises(RuntimeError, match="genuine guard-code bug"):
         jfoo(a)
 

@@ -1,0 +1,476 @@
+"""Symbolic-values caching: shape-polymorphic traces, bucketed dispatch, the
+O(1) cache fast path, and cache observability (ISSUE 2).
+
+Conventions: executors=["jax"] per tier-1 (the kernel executors claim
+half-precision shapes these tiny tests don't use), small buckets via the
+``buckets=`` jit option so CPU runs stay fast.
+"""
+
+import numpy as np
+import pytest
+
+import thunder_tpu as ttpu
+import thunder_tpu.clang as clang
+from thunder_tpu.core.bucketing import BucketPolicy, make_symbolic_spec
+
+
+# =============================================================================
+# Bucket policy
+# =============================================================================
+
+
+class TestBucketPolicy:
+    def test_pow2_buckets(self):
+        p = BucketPolicy()
+        assert p.bucket(0, 1) == (0, 1)
+        assert p.bucket(0, 2) == (1, 2)
+        assert p.bucket(0, 3) == (2, 4)
+        assert p.bucket(0, 5) == (4, 8)
+        assert p.bucket(0, 8) == (4, 8)
+        assert p.bucket(0, 9) == (8, 16)
+
+    def test_seq_multiple_buckets(self):
+        p = BucketPolicy()
+        assert p.bucket(1, 1) == (0, 128)
+        assert p.bucket(1, 128) == (0, 128)
+        assert p.bucket(1, 129) == (128, 256)
+
+    def test_other_dims_exact_by_default(self):
+        p = BucketPolicy()
+        assert p.bucket(2, 7) == (6, 7)
+
+    def test_env_and_option_resolution(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_BUCKETS", "batch=4,seq=exact")
+        p = BucketPolicy.resolve(None)
+        assert p.bucket(0, 5) == (4, 8)  # multiples of 4
+        assert p.bucket(1, 5) == (4, 5)  # exact
+        # per-jit option overrides env
+        p = BucketPolicy.resolve({"seq": "pow2"})
+        assert p.bucket(1, 5) == (4, 8)
+
+    def test_invalid_specs_rejected(self, monkeypatch):
+        with pytest.raises(ValueError):
+            BucketPolicy(batch="fibonacci")
+        with pytest.raises(ValueError):
+            BucketPolicy(seq=0)
+        monkeypatch.setenv("THUNDER_TPU_BUCKETS", "bogus=pow2")
+        with pytest.raises(ValueError):
+            BucketPolicy.resolve(None)
+
+    def test_symbolic_spec_marks_and_extents(self):
+        spec = make_symbolic_spec({0: (0,)}, {0: (5, 4)}, BucketPolicy())
+        assert spec.marks[0][0] == (4, 8, 0)
+        assert spec.padded_extent(0) == 8
+        assert spec.true_extents([np.zeros((6, 4))]) == {0: 6}
+
+    def test_out_of_range_dim_rejected(self):
+        with pytest.raises(ValueError):
+            make_symbolic_spec({0: (3,)}, {0: (5, 4)}, BucketPolicy())
+
+
+# =============================================================================
+# Symbolic caching end to end
+# =============================================================================
+
+
+def _mlp(x, w1, w2):
+    return clang.matmul(clang.tanh(clang.matmul(x, w1)), w2)
+
+
+class TestSymbolicCaching:
+    def test_one_compile_per_bucket_explicit_marks(self):
+        """Acceptance: N distinct batch sizes in one bucket → exactly 1 trace
+        + 1 staged executable, asserted via the new compile counters."""
+        jf = ttpu.jit(
+            lambda x: clang.mul(clang.sin(x), 2.0),
+            cache="symbolic values", executors=["jax"],
+            symbolic_dims={0: (0,)}, buckets={"batch": "pow2"},
+        )
+        for b in (5, 6, 7, 8):  # all in the (4, 8] bucket
+            out = np.asarray(jf(np.ones((b, 4), np.float32)))
+            assert out.shape == (b, 4)
+        info = ttpu.cache_info(jf)
+        assert info["compiles"] == 1
+        assert info["misses"] == 1 and info["hits"] == 3
+        # And the one staged executable really serves the whole bucket: the
+        # padded shapes are identical, so jax.jit compiled exactly once.
+        entry = ttpu.compile_stats(jf).cache_entries[0]
+        cache_size = getattr(entry.computation_fn, "_cache_size", None)
+        if cache_size is not None:
+            assert cache_size() == 1
+
+    def test_auto_marks_from_variation(self):
+        """Default symbolic_dims="auto": the first call compiles exact; the
+        dims observed varying get lifted, and later extents in a bucket hit."""
+        jf = ttpu.jit(
+            lambda x: clang.add(x, 1.0),
+            cache="symbolic values", executors=["jax"], buckets={"batch": "pow2"},
+        )
+        for b in (1, 2, 3, 4, 5, 6, 7, 8):
+            assert np.asarray(jf(np.ones((b, 3), np.float32))).shape == (b, 3)
+        info = ttpu.cache_info(jf)
+        # exact@1, then symbolic (1,2], (2,4], (4,8] — 4 compiles for 8 sizes
+        assert info["compiles"] == 4
+        buckets = [e["buckets"] for e in info["entries"]]
+        assert buckets[0] == "exact" and any("(4,8]" in b for b in buckets)
+        # warm pass: zero further compiles
+        for b in (1, 2, 3, 4, 5, 6, 7, 8):
+            jf(np.ones((b, 3), np.float32))
+        assert ttpu.cache_info(jf)["compiles"] == 4
+
+    def test_gpt_forward_bitwise_once_per_bucket(self):
+        """GPT forward, batch 1–8 and two sequence lengths: compiles once per
+        bucket and matches cache="constant values" bitwise on unpadded rows."""
+        from thunder_tpu.core import dtypes
+        from thunder_tpu.models import gpt as m
+
+        cfg = m.name_to_config("gpt-tiny")
+        params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+        rng = np.random.RandomState(0)
+        fwd = lambda p, i: m.forward(p, i, cfg)
+        jsym = ttpu.jit(fwd, cache="symbolic values", executors=["jax"],
+                        buckets={"batch": "pow2", "seq": 8})
+        jconst = ttpu.jit(fwd, cache="constant values", executors=["jax"])
+
+        for t in (8, 12):
+            for b in range(1, 9):
+                idx = rng.randint(0, cfg.vocab_size, (b, t)).astype(np.int32)
+                out = np.asarray(jsym(params, idx))
+                ref = np.asarray(jconst(params, idx))
+                assert out.shape == (b, t, cfg.padded_vocab_size)
+                np.testing.assert_array_equal(out, ref)
+
+        info = ttpu.cache_info(jsym)
+        # T=8: exact@b1 + 3 batch buckets; T=12 (seq bucket (8,16]): 4 batch
+        # buckets — every other call is a hit.
+        assert info["compiles"] == 8
+        assert info["hits"] == 8
+        # warm sweep compiles nothing
+        for t in (8, 12):
+            for b in range(1, 9):
+                idx = rng.randint(0, cfg.vocab_size, (b, t)).astype(np.int32)
+                jsym(params, idx)
+        assert ttpu.cache_info(jsym)["compiles"] == 8
+
+    def test_masked_mean_matches_unpadded(self):
+        """Padded rows must not perturb reductions: mean over a padded batch
+        is rewritten against the runtime true extent (transforms/padmask.py)."""
+        f = lambda x: clang.mean(clang.mul(clang.add(x, 1.0), 2.0))
+        jsym = ttpu.jit(f, cache="symbolic values", executors=["jax"],
+                        symbolic_dims={0: (0,)}, buckets={"batch": "pow2"})
+        jconst = ttpu.jit(f, cache="constant values", executors=["jax"])
+        for b in (3, 5, 6, 7):
+            x = np.random.RandomState(b).randn(b, 4).astype(np.float32)
+            assert abs(float(np.asarray(jsym(x))) - float(np.asarray(jconst(x)))) < 1e-6
+
+    def test_masked_mean_keepdim(self):
+        """Regression: clang's keepdim path reshapes between the sum and its
+        div; the mean-count link must survive the reshape."""
+        f = lambda x: clang.mean(x, (0,), keepdim=True)
+        jf = ttpu.jit(f, cache="symbolic values", executors=["jax"],
+                      symbolic_dims={0: (0,)}, buckets={"batch": "pow2"})
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        np.testing.assert_allclose(np.asarray(jf(x)), x.mean(0, keepdims=True), rtol=1e-6)
+
+    def test_masked_contraction_right_operand(self):
+        """Regression: a padded contracted dim on the RIGHT matmul operand
+        (nonzero values at padded positions via exp) must be masked too."""
+        def f(w, x):
+            return clang.matmul(w, clang.exp(x))
+
+        jf = ttpu.jit(f, cache="symbolic values", executors=["jax"],
+                      symbolic_dims={1: (0,)}, buckets={"batch": "pow2"})
+        w = np.ones((5, 4), np.float32)
+        x = np.ones((3, 2), np.float32)  # padded to 4 rows; exp(0)=1 at pads
+        np.testing.assert_allclose(np.asarray(jf(w, x)), w[:, :3] @ np.exp(x), rtol=1e-6)
+
+    def test_empty_batch_in_bucket(self):
+        """Regression: extent 0 must land inside a bucket (lo = -1), not
+        escape as an internal GuardFailure."""
+        jf = ttpu.jit(lambda x: clang.mul(x, 2.0), cache="symbolic values",
+                      executors=["jax"], symbolic_dims={0: (0,)},
+                      buckets={"batch": "pow2"})
+        out = np.asarray(jf(np.ones((0, 3), np.float32)))
+        assert out.shape == (0, 3)
+        out = np.asarray(jf(np.ones((1, 3), np.float32)))  # same (−1,1] bucket
+        assert out.shape == (1, 3)
+        assert ttpu.cache_info(jf)["compiles"] == 1
+
+    def test_masked_amax_over_padded_dim(self):
+        # All-negative values: the padded zeros would win an unmasked max.
+        f = lambda x: clang.amax(x, (0,))
+        jf = ttpu.jit(f, cache="symbolic values", executors=["jax"],
+                      symbolic_dims={0: (0,)}, buckets={"batch": "pow2"})
+        for b in (5, 7):
+            x = np.random.RandomState(b).randn(b, 3).astype(np.float32) - 5.0
+            np.testing.assert_allclose(np.asarray(jf(x)), x.max(0), rtol=1e-6)
+
+    def test_gpt_loss_mean_exact_under_padding(self):
+        """Cross-entropy mean loss: the (B,T,V)->(B*T,V) reshape merges the
+        padded batch dim; the mask is rebuilt in the merged layout and the
+        mean's count re-pointed at the true token count."""
+        from thunder_tpu.core import dtypes
+        from thunder_tpu.models import gpt as m
+
+        cfg = m.name_to_config("gpt-tiny")
+        params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+        rng = np.random.RandomState(1)
+        lf = lambda p, i, t: m.loss_fn(p, i, t, cfg)
+        jsym = ttpu.jit(lf, cache="symbolic values", executors=["jax"],
+                        buckets={"batch": "pow2", "seq": 8})
+        jconst = ttpu.jit(lf, cache="constant values", executors=["jax"])
+        for b in (2, 3, 5):
+            idx = rng.randint(0, cfg.vocab_size, (b, 8)).astype(np.int32)
+            tgt = np.roll(idx, -1, 1).astype(np.int32)
+            r = float(np.asarray(jsym(params, idx, tgt)))
+            ref = float(np.asarray(jconst(params, idx, tgt)))
+            assert abs(r - ref) < 1e-5, (b, r, ref)
+
+    def test_grad_crops_to_true_extents(self):
+        def loss(x, w):
+            return clang.mean(clang.tanh(clang.matmul(x, w)))
+
+        gsym = ttpu.value_and_grad(loss, cache="symbolic values", executors=["jax"],
+                                   symbolic_dims={0: (0,)}, buckets={"batch": "pow2"})
+        gconst = ttpu.value_and_grad(loss, cache="constant values", executors=["jax"])
+        w = np.random.RandomState(9).randn(4, 3).astype(np.float32)
+        for b in (3, 5, 7):
+            x = np.random.RandomState(b).randn(b, 4).astype(np.float32)
+            v, gs = gsym(x, w)
+            vr, gr = gconst(x, w)
+            assert abs(float(np.asarray(v)) - float(np.asarray(vr))) < 1e-6
+            for g, ref in zip(gs, gr):
+                g, ref = np.asarray(g), np.asarray(ref)
+                assert g.shape == ref.shape
+                np.testing.assert_allclose(g, ref, atol=1e-5)
+
+    def test_rank_change_is_exact_miss(self):
+        jf = ttpu.jit(lambda x: clang.neg(x), cache="symbolic values",
+                      executors=["jax"], symbolic_dims={0: (0,)},
+                      buckets={"batch": "pow2"})
+        jf(np.ones((2, 3), np.float32))
+        jf(np.ones((4,), np.float32))  # different rank: controlled miss
+        assert ttpu.cache_info(jf)["compiles"] == 2
+
+
+# =============================================================================
+# O(1) fast-path dispatch
+# =============================================================================
+
+
+class TestFastPathDispatch:
+    def test_warm_entry_runs_no_prologue(self):
+        """Acceptance: dispatch on a warm entry no longer executes
+        non-matching prologues — the O(1) key hit skips prologues entirely."""
+        jf = ttpu.jit(lambda x: clang.neg(x))
+        shapes = [(2,), (3,), (4,)]
+        for s in shapes:
+            jf(np.ones(s, np.float32))  # 3 entries compiled
+        cs = ttpu.compile_stats(jf)
+        # Learn every key (the compile path already keyed them).
+        before = cs.prologue_runs
+        jf(np.ones((2,), np.float32))  # oldest entry, warm key
+        after = ttpu.compile_stats(jf).prologue_runs
+        assert after == before, "O(1) hit must not execute any prologue"
+        info = ttpu.cache_info(jf)
+        assert info["fast_hits"] >= 1
+        # Per-entry attribution: the oldest entry took the hit.
+        assert info["entries"][0]["fast_hits"] >= 1
+
+    def test_slow_path_teaches_fast_path(self):
+        jf = ttpu.jit(lambda x: clang.neg(x))
+        jf(np.ones((2,), np.float32))
+        cs = ttpu.compile_stats(jf)
+        cs.fast_cache.clear()  # forget the learned key
+        jf(np.ones((2,), np.float32))  # slow (prologue) hit re-learns it
+        assert ttpu.cache_info(jf)["slow_hits"] == 1
+        p = cs.prologue_runs
+        jf(np.ones((2,), np.float32))
+        assert cs.prologue_runs == p  # now O(1)
+
+    def test_number_type_distinguished(self):
+        # hash(True) == hash(1): the key must still separate them, as the
+        # prologue's type guard does.
+        jf = ttpu.jit(lambda x, n: clang.mul(x, n))
+        x = np.ones((2,), np.float32)
+        jf(x, 1)
+        jf(x, True)
+        assert ttpu.cache_misses(jf) == 2
+        jf(x, 1)
+        jf(x, True)
+        assert ttpu.cache_misses(jf) == 2 and ttpu.cache_hits(jf) == 2
+
+    def test_value_guards_still_checked_on_fast_hit(self):
+        def f(x):
+            if x.sum() > 0:
+                return clang.mul(x, 2.0)
+            return clang.mul(x, -1.0)
+
+        jf = ttpu.jit(f)
+        pos = np.ones((3,), np.float32)
+        neg = -np.ones((3,), np.float32)
+        assert float(np.asarray(jf(pos)).sum()) == 6.0
+        assert float(np.asarray(jf(neg)).sum()) == 3.0  # branch re-specialized
+        # Same metadata key for both: fast hits must re-evaluate the value
+        # guard and route to the right specialization.
+        assert float(np.asarray(jf(pos)).sum()) == 6.0
+        assert float(np.asarray(jf(neg)).sum()) == 3.0
+
+
+# =============================================================================
+# SAME_INPUT short-circuit (scan-order bug surface)
+# =============================================================================
+
+
+class TestSameInputShortCircuit:
+    def test_same_input_uses_newest_entry_without_probing(self):
+        """Regression: under SAME_INPUT a value-guard miss used to append a
+        second stripped entry, and the reversed scan could then bounce to the
+        OLDER specialization when its guards happened to pass. SAME_INPUT now
+        short-circuits to the newest entry, never probing older ones."""
+
+        def f(x):
+            if x.sum() > 0:
+                return clang.mul(x, 2.0)
+            return clang.mul(x, -1.0)
+
+        jf = ttpu.jit(f, cache="same input")
+        pos = np.ones((3,), np.float32)
+        neg = -np.ones((3,), np.float32)
+        jf(pos)
+        cs = ttpu.compile_stats(jf)
+        assert cs.cache_misses == 1 and len(cs.cache_entries) == 1
+        # Differing VALUES silently reuse the first specialization (the
+        # SAME_INPUT contract): no recompile, no second entry, and the
+        # positive-branch program runs on the negative input.
+        out = np.asarray(jf(neg))
+        assert cs.cache_misses == 1 and len(cs.cache_entries) == 1
+        np.testing.assert_allclose(out, neg * 2.0)
+        assert cs.cache_hits == 1
+        # No prologue beyond the (stripped) newest entry's ever runs.
+        assert cs.prologue_runs == 2  # one per call
+
+    def test_same_input_still_skips_metadata_guards(self):
+        # Pre-existing semantics: metadata changes silently reuse too.
+        jf = ttpu.jit(lambda x: clang.neg(x), cache="same input")
+        jf(np.ones((3,), np.float32))
+        jf(np.ones((3,), np.float64))  # differing dtype: silent reuse
+        cs = ttpu.compile_stats(jf)
+        assert cs.cache_misses == 1 and cs.cache_hits == 1
+
+
+# =============================================================================
+# Cache observability
+# =============================================================================
+
+
+class TestCacheObservability:
+    def test_cache_info_counters(self):
+        jf = ttpu.jit(lambda x: clang.neg(x))
+        jf(np.ones((2,), np.float32))
+        jf(np.ones((3,), np.float32))
+        jf(np.ones((2,), np.float32))
+        info = ttpu.cache_info(jf)
+        assert info["cache_option"] == "constant_values"
+        assert info["calls"] == 3
+        assert info["compiles"] == 2 and info["recompiles"] == 1
+        assert info["hits"] == 1 and info["misses"] == 2
+        assert info["trace_seconds"] > 0
+        assert info["first_run_seconds"] > 0
+        assert len(info["entries"]) == 2
+        assert info["entries"][0]["hits"] == 2  # compile call counts as a hit
+
+    def test_cache_info_rejects_uncompiled(self):
+        with pytest.raises(ValueError):
+            ttpu.cache_info(lambda x: x)
+
+    def test_lint_prints_cache_summary(self, capsys):
+        from thunder_tpu.examine import lint
+
+        jf = ttpu.jit(lambda x: clang.neg(x), executors=["jax"])
+        x = np.ones((2,), np.float32)
+        jf(x)
+        diags = lint(jf, x, executors=["jax"])
+        out = capsys.readouterr().out
+        assert "cache[constant_values]" in out
+        assert "1 compiles" in out
+        assert not any(d.severity.name == "ERROR" for d in diags)
+
+
+# =============================================================================
+# Persistent-cache config (small fix)
+# =============================================================================
+
+
+class TestPersistentCacheConfig:
+    def test_user_env_knobs_respected(self, monkeypatch):
+        import jax
+
+        from thunder_tpu.api import _set_unless_user_configured
+
+        monkeypatch.setenv("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "4096")
+        before = jax.config.jax_persistent_cache_min_entry_size_bytes
+        _set_unless_user_configured(jax, "jax_persistent_cache_min_entry_size_bytes", 0)
+        assert jax.config.jax_persistent_cache_min_entry_size_bytes == before
+        monkeypatch.delenv("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES")
+        _set_unless_user_configured(jax, "jax_persistent_cache_min_entry_size_bytes", before)
+
+    def test_programmatic_knobs_respected(self):
+        import jax
+
+        from thunder_tpu.api import _set_unless_user_configured
+
+        before = jax.config.jax_persistent_cache_min_entry_size_bytes
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 4096)
+        try:
+            _set_unless_user_configured(jax, "jax_persistent_cache_min_entry_size_bytes", 0)
+            assert jax.config.jax_persistent_cache_min_entry_size_bytes == 4096
+        finally:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", before)
+
+    def test_active_cache_dir_logged_once(self, caplog):
+        import logging
+
+        from thunder_tpu.api import _cache_dir_logged, _log_cache_dir_once
+
+        _cache_dir_logged["dir"] = None
+        with caplog.at_level(logging.INFO, logger="thunder_tpu"):
+            _log_cache_dir_once("/tmp/somewhere")
+            _log_cache_dir_once("/tmp/somewhere")
+        assert sum("persistent XLA compile cache" in r.message for r in caplog.records) == 1
+
+
+# =============================================================================
+# Tier-1 smoke: the symbolic path stays verifier-clean (THUNDER_TPU_CHECKS=1)
+# =============================================================================
+
+
+@pytest.mark.checks_smoke
+class TestSymbolicChecksSmoke:
+    def test_symbolic_pipeline_under_checks(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_CHECKS", "1")
+
+        def f(x):
+            return clang.mean(clang.tanh(x))
+
+        jf = ttpu.jit(f, cache="symbolic values", executors=["jax"],
+                      symbolic_dims={0: (0,)}, buckets={"batch": "pow2"})
+        for b in (5, 6, 7):  # one bucket: (4, 8]
+            assert np.isfinite(float(np.asarray(jf(np.ones((b, 4), np.float32)))))
+        assert ttpu.cache_info(jf)["compiles"] == 1
+
+    def test_symbolic_gpt_forward_under_checks(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_CHECKS", "1")
+        from thunder_tpu.core import dtypes
+        from thunder_tpu.models import gpt as m
+
+        cfg = m.name_to_config("gpt-tiny")
+        params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+        jf = ttpu.jit(lambda p, i: m.forward(p, i, cfg), cache="symbolic values",
+                      executors=["jax"], buckets={"batch": "pow2", "seq": 8})
+        rng = np.random.RandomState(0)
+        for b in (2, 3):
+            idx = rng.randint(0, cfg.vocab_size, (b, 8)).astype(np.int32)
+            out = np.asarray(jf(params, idx))
+            assert out.shape == (b, 8, cfg.padded_vocab_size)
+            assert np.isfinite(out).all()

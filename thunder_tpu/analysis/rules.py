@@ -87,6 +87,11 @@ _META_EXEMPT_IDS = {
     PrimIDs.CHECK_LEN,
     PrimIDs.CHECK_KEYS,
     PrimIDs.CHECK_NONE,
+    # Symbolic-values guards: structural plumbing over concrete caller data,
+    # like the checks above (and unpack_dim's output is a NumberProxy, which
+    # the meta rules do not model).
+    PrimIDs.UNPACK_DIM,
+    PrimIDs.CHECK_DIM_BUCKET,
 }
 
 

@@ -45,7 +45,7 @@ from thunder_tpu.core.proxies import (
     proxy,
     tensorproxy_from_concrete,
 )
-from thunder_tpu.core.pytree import tree_flatten, tree_map
+from thunder_tpu.core.pytree import tree_flatten, tree_map, tree_unflatten
 from thunder_tpu.core.trace import TraceCtx, from_trace, tracectx
 from thunder_tpu.executors import bridge, jaxex, pythonex  # register executors  # noqa: F401
 from thunder_tpu.executors import flashex, pallasex  # higher-priority kernel executors  # noqa: F401
@@ -123,6 +123,24 @@ def _build_prologue(
 
         def guard_leaf(p: Any, concrete: Any) -> None:
             if isinstance(p, TensorProxy):
+                sdims = getattr(p, "_symbolic_dims", None)
+                if sdims:
+                    # Symbolic-values caching: marked dims guard only RANK here
+                    # (None = wildcard extent); each marked dim is lifted into a
+                    # NumberProxy and bucket-constrained, so one entry serves
+                    # every extent in the bucket (core/bucketing.py).
+                    shape_spec = tuple(
+                        None if i in sdims else int(s) for i, s in enumerate(p.shape)
+                    )
+                    prims.check_tensor_shape_and_metadata(
+                        p, shape_spec, str(p.device), p.true_dtype, p.requires_grad,
+                        bridge.framework_of(concrete),
+                    )
+                    for i in sorted(sdims):
+                        lo, hi, _cid = sdims[i]
+                        d = prims.unpack_dim(p, i)
+                        prims.check_dim_bucket(d, lo, hi)
+                    return
                 prims.check_tensor_shape_and_metadata(
                     p, tuple(p.shape), str(p.device), p.true_dtype, p.requires_grad, bridge.framework_of(concrete)
                 )
@@ -316,7 +334,8 @@ def _collect_input_mutations(
 
 
 def trace_program(
-    fn: Callable, args: tuple, kwargs: dict, *, record_input_mutations: bool = False
+    fn: Callable, args: tuple, kwargs: dict, *, record_input_mutations: bool = False,
+    symbolic_marks: Optional[dict] = None,
 ) -> tuple[TraceCtx, TraceCtx]:
     """Acquire ``fn`` as (prologue_trace, computation_trace).
 
@@ -341,6 +360,13 @@ def trace_program(
     # tree_flatten(params) gives the user.
     leaves, _ = tree_flatten((proxied_args, proxied_kwargs))
     tensor_leaves = [p for p in leaves if isinstance(p, TensorProxy)]
+
+    if symbolic_marks:
+        # cache="symbolic values": the caller traces on bucket-padded example
+        # inputs; marked dims carry their bucket so the prologue guards
+        # membership instead of the exact extent (core/bucketing.py).
+        for li, dims in symbolic_marks.items():
+            tensor_leaves[li]._symbolic_dims = dict(dims)
 
     comp_trc.args = tuple(tensor_leaves)
     # Concrete example inputs aligned with the tensor args: lets traced
@@ -424,17 +450,29 @@ def _compile_entry(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict)
     from thunder_tpu.core.trace import debug_checks
 
     with debug_checks(cd.compile_options.get("debug_checks")):
-        return _compile_entry_checked(cd, cs, args, kwargs)
+        if cd.cache_option is CACHE_OPTIONS.SYMBOLIC_VALUES:
+            sym_spec = _symbolic_spec_for_call(cd, cs, args, kwargs)
+            if sym_spec is not None:
+                pargs, pkwargs = _pad_example(args, kwargs, sym_spec)
+                return _compile_entry_checked(cd, cs, pargs, pkwargs, sym_spec)
+        return _compile_entry_checked(cd, cs, args, kwargs, None)
 
 
-def _compile_entry_checked(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict) -> CacheEntry:
+def _compile_entry_checked(
+    cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict, sym_spec
+) -> CacheEntry:
     import jax
 
     from thunder_tpu.core.trace import mark
 
+    build_start = timer_ns()
+    cs.compile_count += 1
     cs.last_trace_tracing_start = timer_ns()
     with sharp_edges_policy(cd.sharp_edges):
-        plg_trc, comp_trc = trace_program(cd.fn, args, kwargs, record_input_mutations=True)
+        plg_trc, comp_trc = trace_program(
+            cd.fn, args, kwargs, record_input_mutations=True,
+            symbolic_marks=sym_spec.marks if sym_spec is not None else None,
+        )
     # Stamp (and, under debug checks, verify) the freshly acquired traces so
     # an acquisition bug is attributed to acquisition, not the first pass.
     mark(comp_trc, "Acquisition")
@@ -459,10 +497,35 @@ def _compile_entry_checked(cd: CompileData, cs: CompileStats, args: tuple, kwarg
     comp_trc = cse(comp_trc)
     computation_traces.append(comp_trc)
 
+    if sym_spec is not None:
+        # Thread validity masks through reductions over bucket-padded dims and
+        # derive the output crop plan — BEFORE grad, so the masked program is
+        # what gets differentiated (masks are constants w.r.t. the inputs).
+        from thunder_tpu.transforms.padmask import thread_pad_masks
+
+        comp_trc, mask_classes, crop_plan, pad_warnings = thread_pad_masks(comp_trc, sym_spec)
+        comp_trc = dce(comp_trc)  # sweep replaced reductions' dead count constants
+        computation_traces.append(comp_trc)
+        sym_spec.mask_classes = mask_classes
+        sym_spec.crop_plan = crop_plan
+        if pad_warnings:
+            import warnings
+
+            for w in pad_warnings:
+                warnings.warn(f"cache='symbolic values': {w}", stacklevel=2)
+
     # Trace-to-trace transforms requested at jit() time (grad, autocast, ...).
-    for tt in cd.compile_options.get("_trace_transforms", ()):
+    trace_transforms = cd.compile_options.get("_trace_transforms", ())
+    for tt in trace_transforms:
         comp_trc = tt(comp_trc)
         computation_traces.append(comp_trc)
+    if sym_spec is not None and trace_transforms:
+        # The grad/autocast rewrite minted new output proxies (grads); re-run
+        # the provenance analysis on the transformed trace so the crop plan
+        # covers them exactly (transforms/padmask.py).
+        from thunder_tpu.transforms.padmask import analyze_crop_plan
+
+        sym_spec.crop_plan = analyze_crop_plan(comp_trc, sym_spec)
 
     # Joint-trace attention-residual saving: when grad produced fw+bw in one
     # trace, let the flash backward consume saved (out, lse) instead of
@@ -516,11 +579,16 @@ def _compile_entry_checked(cd: CompileData, cs: CompileStats, args: tuple, kwarg
     device_sync = _has_tag_in_trace(extrace, OpTags.DEVICE_SYNC_OP)
     if cd.disable_jit_staging or device_sync:
         computation_fn = trace_callable
+    elif sym_spec is not None:
+        # Bucketed staging: padded input buffers are dispatch-owned
+        # temporaries, donated to XLA off-CPU (executors/jaxex.py).
+        computation_fn = jaxex.stage_bucketed(trace_callable, sorted(sym_spec.marks))
     else:
         computation_fn = jax.jit(trace_callable)
 
     torch_facing = any(bridge.is_torch_tensor(x) for x in tree_flatten((args, kwargs))[0])
 
+    flat_call, call_treedef = tree_flatten((args, kwargs))
     entry = CacheEntry(
         prologue_fn=prologue_fn,
         computation_fn=computation_fn,
@@ -532,7 +600,12 @@ def _compile_entry_checked(cd: CompileData, cs: CompileStats, args: tuple, kwarg
         torch_facing=torch_facing,
         needs_rng=needs_rng,
         value_guards=value_guards,
+        sym_spec=sym_spec,
+        treedef=call_treedef,
+        leaf_meta=_leaf_meta(flat_call),
     )
+    entry.stats.trace_s = (timer_ns() - build_start) / 1e9
+    cs.trace_seconds += entry.stats.trace_s
 
     cs.last_traces = computation_traces
     cs.last_prologue_traces = plg_traces
@@ -643,16 +716,237 @@ def _build_epilogue(muts: list) -> Callable:
     return epilogue
 
 
-def _run_entry(entry: CacheEntry, flat_inps: tuple) -> Any:
+def _prepare_inputs(entry: CacheEntry, flat_inps) -> tuple[list, Optional[dict]]:
+    """(jax inputs — bucket-padded for symbolic entries, true extents) for an
+    entry. Shared by value-guard evaluation and execution so a value-guarded
+    dispatch converts/pads each leaf exactly once."""
     inps = [bridge.to_jax(x) for x in flat_inps]
+    true_extents = None
+    if entry.sym_spec is not None:
+        true_extents = entry.sym_spec.true_extents(flat_inps)
+        inps = jaxex.pad_to_bucket(inps, entry.sym_spec)
+    return inps, true_extents
+
+
+def _run_entry(entry: CacheEntry, flat_inps: tuple, prepared=None) -> Any:
+    inps, true_extents = prepared if prepared is not None else _prepare_inputs(entry, flat_inps)
+    if entry.sym_spec is not None:
+        import numpy as np
+
+        # Runtime true extents feed the reduction masks (transforms/padmask.py).
+        inps = inps + [
+            np.asarray(true_extents[cid], np.int32) for cid in entry.sym_spec.mask_classes
+        ]
     if entry.needs_rng:
-        inps.append(_next_key())
+        inps = inps + [_next_key()]
     out = entry.computation_fn(*inps)
+    if entry.sym_spec is not None:
+        out = jaxex.crop_to_extents(out, entry.sym_spec, true_extents)
     if entry.torch_facing:
         import jax
 
         out = tree_map(lambda x: bridge.to_torch(x) if isinstance(x, jax.Array) else x, out)
     return out
+
+
+# =============================================================================
+# Dispatch: O(1) fast path + symbolic-values (bucketed) compilation
+# =============================================================================
+
+
+def _leaf_meta(flat: list) -> tuple:
+    """Hashable per-leaf metadata covering everything the prologue guards:
+    tensor (shape, dtype, device kind, requires_grad, framework), number
+    type+value, string value, None. Opaque objects key by type only — the
+    prologue cannot guard them either (sharp edge)."""
+    parts = []
+    for x in flat:
+        if bridge.is_concrete_tensor(x):
+            shape, dev, dt, rg = bridge.tensor_metadata(x)
+            parts.append(
+                ("T", tuple(int(s) for s in shape), str(dt), str(dev).split(":")[0],
+                 rg, bridge.framework_of(x))
+            )
+        elif isinstance(x, (bool, int, float, complex, str)) or x is None:
+            parts.append((type(x).__name__, x))
+        else:
+            parts.append(("O", type(x).__name__))
+    return tuple(parts)
+
+
+_FAST_CACHE_MAX = 4096
+
+
+def _probe_entries(cs: CompileStats, args: tuple, kwargs: dict):
+    """Full prologue scan, newest entries first (the slow path): each probe
+    executes the candidate's prologue; GuardFailure is the controlled miss
+    signal (reference: thunder/__init__.py:409-447). Returns (entry,
+    flat_inps, prepared) — ``prepared`` is the converted/padded input set
+    when value guards forced preparing it (reused by _run_entry)."""
+    from thunder_tpu.core.concrete import check_value_guards
+
+    for entry in reversed(cs.cache_entries):
+        cs.prologue_runs += 1
+        entry.stats.prologue_runs += 1
+        try:
+            flat_inps = entry.prologue_fn(*args, **kwargs)
+        except GuardFailure:
+            # Controlled signal from a CHECK_* prim: this entry's guards
+            # don't match → probe the next entry. Any other exception is a
+            # genuine bug (in guard code or user input) and propagates.
+            entry.stats.guard_fails += 1
+            continue
+        prepared = None
+        if entry.value_guards:
+            # The guard subprograms were staged on the (padded) trace shapes.
+            prepared = _prepare_inputs(entry, flat_inps)
+            if not check_value_guards(entry.value_guards, prepared[0]):
+                entry.stats.guard_fails += 1
+                continue
+        return entry, flat_inps, prepared
+    return None, None, None
+
+
+def _symbolic_spec_for_call(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict):
+    """Which dims to lift symbolic for THIS compile, or None for an exact
+    entry. Explicit ``symbolic_dims`` marks apply from the first call;
+    ``"auto"`` (the default) marks the dims observed VARYING against a cached
+    entry of the same shape class — parameters never vary, so they are never
+    padded, while batch/sequence dims self-discover."""
+    from thunder_tpu.core.bucketing import make_symbolic_spec
+
+    flat, treedef = tree_flatten((args, kwargs))
+    tensor_pos = [i for i, x in enumerate(flat) if bridge.is_concrete_tensor(x)]
+    shapes = {li: tuple(int(s) for s in flat[i].shape) for li, i in enumerate(tensor_pos)}
+
+    explicit = cd.compile_options.get("symbolic_dims", "auto")
+    if explicit is None or explicit == "auto":
+        marks_dims = _marks_from_variation(cs, _leaf_meta(flat), treedef)
+    elif explicit == "all":
+        marks_dims = {li: tuple(range(len(s))) for li, s in shapes.items()}
+    elif isinstance(explicit, dict):
+        marks_dims = {int(li): tuple(ds) for li, ds in explicit.items()}
+    elif isinstance(explicit, (tuple, list)):
+        marks_dims = {
+            li: tuple(d for d in explicit if d < len(s)) for li, s in shapes.items()
+        }
+        marks_dims = {li: ds for li, ds in marks_dims.items() if ds}
+    else:
+        raise ValueError(
+            f"symbolic_dims: expected 'auto', 'all', a dict of leaf->dims, or a "
+            f"dim tuple; got {explicit!r}"
+        )
+    marks_dims = {li: ds for li, ds in marks_dims.items() if ds}
+    if not marks_dims:
+        return None
+    # jit() resolves the policy whenever cache_option is SYMBOLIC_VALUES —
+    # the only path that reaches this function.
+    return make_symbolic_spec(marks_dims, shapes, cd.compile_options["_bucket_policy"])
+
+
+def _marks_from_variation(cs: CompileStats, cur_meta: tuple, treedef) -> dict:
+    """Compare the call's leaf metadata against cached entries of the same
+    shape class; the dims whose extents differ (plus the entry's existing
+    symbolic dims) become the new entry's marks."""
+    for entry in reversed(cs.cache_entries):
+        if entry.treedef != treedef or len(entry.leaf_meta) != len(cur_meta):
+            continue
+        entry_marks = entry.sym_spec.marks if entry.sym_spec is not None else {}
+        marks: dict[int, tuple] = {}
+        li = -1
+        ok = True
+        for cm, em in zip(cur_meta, entry.leaf_meta):
+            if cm[0] == "T" or em[0] == "T":
+                if cm[0] != "T" or em[0] != "T":
+                    ok = False
+                    break
+                li += 1
+                if cm[2:] != em[2:] or len(cm[1]) != len(em[1]):
+                    ok = False  # dtype/device/rank class differs: not this entry
+                    break
+                inherited = set(entry_marks.get(li, {}).keys())
+                diff = {d for d in range(len(cm[1])) if cm[1][d] != em[1][d]}
+                dims = inherited | diff
+                if dims:
+                    marks[li] = tuple(sorted(dims))
+            elif cm != em:
+                ok = False
+                break
+        if ok and marks:
+            return marks
+    return {}
+
+
+def _pad_example(args: tuple, kwargs: dict, sym_spec) -> tuple[tuple, dict]:
+    """Zero-pad the example inputs up to the spec's bucket ceilings — the
+    shapes the symbolic trace is acquired on."""
+    flat, treedef = tree_flatten((args, kwargs))
+    tensor_pos = [i for i, x in enumerate(flat) if bridge.is_concrete_tensor(x)]
+    for li, dims in sym_spec.marks.items():
+        i = tensor_pos[li]
+        flat[i] = _pad_concrete(flat[i], {d: hi for d, (_lo, hi, _cid) in dims.items()})
+    return tree_unflatten(treedef, flat)
+
+
+def _pad_concrete(x: Any, targets: dict):
+    widths = [(0, 0)] * len(x.shape)
+    padded = False
+    for d, t in targets.items():
+        delta = int(t) - int(x.shape[d])
+        if delta > 0:
+            widths[d] = (0, delta)
+            padded = True
+    if not padded:
+        return x
+    if bridge.is_torch_tensor(x):
+        import torch
+
+        for d, (_z, delta) in enumerate(widths):
+            if delta:
+                pad_shape = list(x.shape)
+                pad_shape[d] = delta
+                x = torch.cat(
+                    [x, torch.zeros(pad_shape, dtype=x.dtype, device=x.device)], dim=d
+                )
+        return x
+    import numpy as np
+
+    if isinstance(x, np.ndarray):
+        return np.pad(x, widths)
+    import jax.numpy as jnp
+
+    return jnp.pad(x, widths)
+
+
+def cache_info(fn: Callable) -> dict:
+    """Cache observability for a thunder_tpu-compiled function: aggregate and
+    per-entry hit/miss/recompile counters plus cumulative trace/first-run
+    seconds (ISSUE 2; printed by ``examine.lint``'s summary)."""
+    cs = _get_cs(fn)
+    cd = getattr(fn, "_lc_cd", None)
+    return {
+        "cache_option": cd.cache_option.name.lower() if cd is not None else None,
+        "calls": cs.calls,
+        "hits": cs.cache_hits,
+        "misses": cs.cache_misses,
+        "fast_hits": cs.fast_hits,
+        "slow_hits": cs.slow_hits,
+        "prologue_runs": cs.prologue_runs,
+        "compiles": cs.compile_count,
+        "recompiles": cs.recompile_count,
+        "trace_seconds": cs.trace_seconds,
+        "first_run_seconds": cs.first_run_seconds,
+        "cache_lookup_us_total": cs.cache_lookup_ns / 1e3,
+        "entries": [
+            dict(
+                index=i,
+                symbolic=(e.sym_spec is not None),
+                buckets=(e.sym_spec.describe() if e.sym_spec is not None else "exact"),
+                **e.stats.as_dict(),
+            )
+            for i, e in enumerate(cs.cache_entries)
+        ],
+    }
 
 
 # =============================================================================
@@ -678,21 +972,54 @@ def _ensure_runtime() -> None:
     # descriptor-keyed compiled-fusion cache, SURVEY.md §2.2 — here the
     # cache survives processes, so warm-start recompiles of the same
     # program are file reads, not 80-second XLA runs). Opt out with
-    # THUNDER_TPU_NO_COMPILE_CACHE=1.
+    # THUNDER_TPU_NO_COMPILE_CACHE=1. A user-configured cache (dir already
+    # set, or the JAX_PERSISTENT_CACHE_* env knobs) is respected untouched.
     import os
 
     if not os.environ.get("THUNDER_TPU_NO_COMPILE_CACHE"):
-        cache_dir = os.environ.get(
-            "THUNDER_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/thunder_tpu_xla")
-        )
         try:
-            if not jax.config.jax_compilation_cache_dir:
+            cache_dir = jax.config.jax_compilation_cache_dir
+            if not cache_dir:
+                cache_dir = os.environ.get(
+                    "THUNDER_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/thunder_tpu_xla")
+                )
                 os.makedirs(cache_dir, exist_ok=True)
                 jax.config.update("jax_compilation_cache_dir", cache_dir)
-                jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-                jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+                _set_unless_user_configured(
+                    jax, "jax_persistent_cache_min_compile_time_secs", 1.0
+                )
+                _set_unless_user_configured(
+                    jax, "jax_persistent_cache_min_entry_size_bytes", 0
+                )
+            _log_cache_dir_once(cache_dir)
         except Exception:
             pass  # older jax without the persistent-cache config
+
+
+def _set_unless_user_configured(jax_mod, name: str, value) -> None:
+    """Apply our persistent-cache tuning only when the user has not already
+    configured the knob — via the env var jax reads, or programmatically.
+    The values we set equal jax's own defaults, so a current value that
+    differs from ours can only mean the user changed it: respect it."""
+    import os
+
+    if os.environ.get(name.upper()) is not None:
+        return
+    if getattr(jax_mod.config, name) != value:
+        return
+    jax_mod.config.update(name, value)
+
+
+_cache_dir_logged = {"dir": None}
+
+
+def _log_cache_dir_once(cache_dir: str) -> None:
+    if _cache_dir_logged["dir"] == cache_dir:
+        return
+    _cache_dir_logged["dir"] = cache_dir
+    import logging
+
+    logging.getLogger("thunder_tpu").info("persistent XLA compile cache: %s", cache_dir)
 
 
 def jit(
@@ -715,6 +1042,16 @@ def jit(
     after every transform pass, raising ``TraceVerificationError`` attributed
     to the pass that broke an invariant; ``False`` disables it; ``None``
     (default) defers to the ``THUNDER_TPU_CHECKS`` environment variable.
+
+    ``cache="symbolic values"`` enables shape-polymorphic caching: marked
+    tensor dims are lifted into bucket guards (``lo < d <= hi``) instead of
+    exact extents, inputs are zero-padded up to the bucket ceiling at
+    dispatch, reductions over padded dims are masked against the runtime
+    true extents, and outputs are cropped back — one trace + one XLA compile
+    per bucket. Options: ``symbolic_dims`` ("auto" = mark dims observed
+    varying, "all", a ``{tensor_leaf_index: (dims...)}`` dict, or a dim
+    tuple) and ``buckets`` (e.g. ``{"batch": "pow2", "seq": 128}``; also the
+    ``THUNDER_TPU_BUCKETS`` env var). See docs/caching.md.
     """
     if fn is None:
         return functools.partial(
@@ -754,10 +1091,22 @@ def jit(
             **compile_options
         )
 
+    cache_option = resolve_cache_option(cache)
+    if cache_option is CACHE_OPTIONS.SYMBOLIC_VALUES:
+        # Resolve the shape-bucketing policy once, at jit() time: defaults
+        # (pow2 batch, 128-multiple seq) <- THUNDER_TPU_BUCKETS <- buckets=.
+        from thunder_tpu.core.bucketing import BucketPolicy
+
+        compile_options["_bucket_policy"] = BucketPolicy.resolve(
+            compile_options.pop("buckets", None)
+        )
+    else:
+        compile_options.pop("buckets", None)
+
     cd = CompileData(
         fn=fn,
         executors_list=resolve_executors(executors),
-        cache_option=resolve_cache_option(cache),
+        cache_option=cache_option,
         sharp_edges=resolve_sharp_edges_option(sharp_edges),
         disable_jit_staging=disable_jit_staging,
         compile_options=dict(compile_options, debug_checks=debug_checks),
@@ -766,37 +1115,85 @@ def jit(
 
     @functools.wraps(fn)
     def fn_(*args, **kwargs):
+        from thunder_tpu.core.concrete import check_value_guards
+
         cs.calls += 1
         cs.last_trace_host_start = timer_ns()
-        # Cache probe: newest entries first (reference: __init__.py:409-447).
         cs.last_trace_cache_start = timer_ns()
-        for entry in reversed(cs.cache_entries):
-            try:
-                flat_inps = entry.prologue_fn(*args, **kwargs)
-            except GuardFailure:
-                # Controlled signal from a CHECK_* prim: this entry's guards
-                # don't match → probe the next entry. Any other exception is a
-                # genuine bug (in guard code or user input) and propagates.
-                continue
-            if entry.value_guards:
-                from thunder_tpu.core.concrete import check_value_guards
+        co = cd.cache_option
+        entry = None
+        flat_inps = None
+        prepared = None
+        key = None
+        if co in (CACHE_OPTIONS.CONSTANT_VALUES, CACHE_OPTIONS.SYMBOLIC_VALUES):
+            flat, treedef = tree_flatten((args, kwargs))
+            key = (treedef, _leaf_meta(flat))
 
-                guard_inps = [bridge.to_jax(x) for x in flat_inps]
-                if not check_value_guards(entry.value_guards, guard_inps):
-                    continue
+        if co is CACHE_OPTIONS.SAME_INPUT and cs.cache_entries:
+            # SAME_INPUT short-circuits to the NEWEST entry: the user asserts
+            # every call repeats the first one's metadata AND values, so no
+            # probing (and no value-guard re-evaluation) happens — previously
+            # a value-guard miss could compile a second entry and the reversed
+            # scan could then bounce between specializations.
+            entry = cs.cache_entries[-1]
+            cs.prologue_runs += 1
+            entry.stats.prologue_runs += 1
+            flat_inps = entry.prologue_fn(*args, **kwargs)
+        elif key is not None and cs.cache_entries:
+            # Two-tier dispatch. Tier 1: O(1) key hit — (tree structure, per
+            # leaf rank/shape/dtype/device/value metadata) → entry, learned on
+            # the first slow hit; no prologue executes on the warm path.
+            cand = cs.fast_cache.get(key)
+            if cand is not None:
+                leaves = [x for x in flat if bridge.is_concrete_tensor(x)]
+                guards_ok = True
+                if cand.value_guards:
+                    prepared = _prepare_inputs(cand, leaves)
+                    guards_ok = check_value_guards(cand.value_guards, prepared[0])
+                if guards_ok:
+                    entry = cand
+                    flat_inps = leaves
+                    cs.fast_hits += 1
+                    entry.stats.fast_hits += 1
+                else:
+                    prepared = None
+            if entry is None:
+                # Tier 2: full prologue scan, newest first; a hit teaches the
+                # fast path this key.
+                entry, flat_inps, prepared = _probe_entries(cs, args, kwargs)
+                if entry is not None:
+                    cs.slow_hits += 1
+                    if len(cs.fast_cache) > _FAST_CACHE_MAX:
+                        cs.fast_cache.clear()
+                    cs.fast_cache[key] = entry
+
+        if entry is not None:
             cs.cache_hits += 1
+            entry.stats.hits += 1
             cs.last_trace_cache_stop = timer_ns()
-            result = _run_entry(entry, flat_inps)
+            cs.cache_lookup_ns += cs.last_trace_cache_stop - cs.last_trace_cache_start
+            result = _run_entry(entry, flat_inps, prepared)
             if entry.epilogue_fn is not None:
                 result = entry.epilogue_fn(args, kwargs, flat_inps, result)
             cs.last_trace_host_stop = timer_ns()
             return result
         cs.last_trace_cache_stop = timer_ns()
+        cs.cache_lookup_ns += cs.last_trace_cache_stop - cs.last_trace_cache_start
 
         cs.cache_misses += 1
         entry = _compile_entry(cd, cs, args, kwargs)
+        if key is not None:
+            if len(cs.fast_cache) > _FAST_CACHE_MAX:
+                cs.fast_cache.clear()
+            cs.fast_cache[key] = entry
+        entry.stats.hits += 1
+        cs.prologue_runs += 1
+        entry.stats.prologue_runs += 1
         flat_inps = entry.prologue_fn(*args, **kwargs)
+        run_start = timer_ns()
         result = _run_entry(entry, flat_inps)
+        entry.stats.first_run_s = (timer_ns() - run_start) / 1e9
+        cs.first_run_seconds += entry.stats.first_run_s
         if entry.epilogue_fn is not None:
             result = entry.epilogue_fn(args, kwargs, flat_inps, result)
         cs.last_trace_host_stop = timer_ns()

@@ -145,6 +145,23 @@ class CompileData:
         return dict(self._used_options)
 
 
+class EntryStats:
+    """Per-cache-entry counters (ISSUE 2: cache observability)."""
+
+    __slots__ = ("hits", "fast_hits", "prologue_runs", "guard_fails", "trace_s", "first_run_s")
+
+    def __init__(self):
+        self.hits = 0  # times this entry served a call
+        self.fast_hits = 0  # ... of which via the O(1) key fast path
+        self.prologue_runs = 0  # times this entry's prologue executed
+        self.guard_fails = 0  # prologue/value-guard rejections during probes
+        self.trace_s = 0.0  # host tracing+transform time building this entry
+        self.first_run_s = 0.0  # first execution (includes the XLA compile)
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
 @dataclass
 class CacheEntry:
     """One compiled specialization (reference: thunder/__init__.py:281)."""
@@ -162,6 +179,15 @@ class CacheEntry:
     # Guards over input-derived scalar values that the trace specialized on
     # (core/concrete.py): all must re-evaluate equal for a cache hit.
     value_guards: tuple = ()
+    # Symbolic-values caching (core/bucketing.SymbolicSpec) — None for exact
+    # entries. When set, dispatch pads marked dims to the bucket ceiling,
+    # appends true-extent scalars for masked reductions, and crops outputs.
+    sym_spec: Any = None
+    # Shape-class record for automatic symbolic-dim detection: the flatten
+    # treedef and per-leaf metadata of the inputs this entry was built from.
+    treedef: Any = None
+    leaf_meta: tuple = ()
+    stats: EntryStats = field(default_factory=EntryStats)
 
 
 class CompileStats:
@@ -175,6 +201,18 @@ class CompileStats:
         self.last_traces: list = []
         self.last_prologue_traces: list = []
         self.last_backward_traces: list = []
+        # O(1) dispatch fast path: (treedef, leaf metadata) -> CacheEntry,
+        # learned on the first slow (prologue-scanning) hit for a key. Bounded;
+        # cleared wholesale on overflow (keys regenerate on the next slow hit).
+        self.fast_cache: dict = {}
+        self.fast_hits: int = 0
+        self.slow_hits: int = 0
+        self.prologue_runs: int = 0
+        # Compile-side counters/accumulators (ISSUE 2: cache observability).
+        self.compile_count: int = 0
+        self.trace_seconds: float = 0.0
+        self.first_run_seconds: float = 0.0
+        self.cache_lookup_ns: int = 0
         # nanosecond timers
         self.last_trace_host_start: int = 0
         self.last_trace_host_stop: int = 0
@@ -188,6 +226,15 @@ class CompileStats:
     @property
     def last_compile_time_ms(self) -> float:
         return (self.last_trace_tracing_stop - self.last_trace_tracing_start) / 1e6
+
+    @property
+    def recompile_count(self) -> int:
+        """Compiles beyond the first — the recompile-storm signal."""
+        return max(0, self.compile_count - 1)
+
+    @property
+    def last_cache_lookup_us(self) -> float:
+        return (self.last_trace_cache_stop - self.last_trace_cache_start) / 1e3
 
 
 def timer_ns() -> int:

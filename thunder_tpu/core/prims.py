@@ -81,6 +81,11 @@ class PrimIDs(enum.Enum):
     CHECK_LEN = enum.auto()
     CHECK_KEYS = enum.auto()
     CHECK_NONE = enum.auto()
+    # Symbolic-values caching (cache="symbolic values"): a marked tensor dim
+    # is lifted into a NumberProxy by UNPACK_DIM and constrained by
+    # CHECK_DIM_BUCKET instead of the exact-extent metadata check.
+    UNPACK_DIM = enum.auto()
+    CHECK_DIM_BUCKET = enum.auto()
     # Utility
     DEL = enum.auto()
     RETURN = enum.auto()
@@ -446,8 +451,12 @@ def _check_tensor_metadata_impl(t, shape, device, dtype, requires_grad, framewor
     if not is_concrete_tensor(t):
         raise GuardFailure(f"Expected a tensor, got {type(t).__name__}")
     actual_shape, actual_device, actual_dtype, actual_rg = tensor_metadata(t)
+    # A None extent is a symbolic (wildcard) dim: only the rank is enforced
+    # here — the dim's value is unpacked by unpack_dim and constrained by
+    # check_dim_bucket (cache="symbolic values").
     if (
-        tuple(actual_shape) != tuple(shape)
+        len(actual_shape) != len(shape)
+        or any(s is not None and int(a) != int(s) for a, s in zip(actual_shape, shape))
         or actual_dtype != dtype
         or actual_rg != requires_grad
         or actual_device.split(":")[0] != str(device).split(":")[0]
@@ -579,6 +588,56 @@ check_none = make_prim(
     _check_none_meta,
     tags=(OpTags.GUARD_OP, OpTags.DONT_DCE),
     python_impl=_check_none_impl,
+)
+
+
+def _unpack_dim_meta(t: TensorProxy, dim: int) -> NumberProxy:
+    # The observed (bucket-padded) extent is the known value; the proxy IS
+    # the symbolic dim — the "lifted NumberProxy" of symbolic-values caching.
+    from thunder_tpu.core.proxies import IntegerProxy
+
+    return IntegerProxy(int(t.shape[dim]))
+
+
+def _unpack_dim_impl(t, dim: int) -> int:
+    return int(t.shape[dim])
+
+
+def _unpack_dim_printer(bsym) -> str:
+    t, dim = bsym.args
+    t_s = t.name if isinstance(t, Proxy) else codeutils.prettyprint(t)
+    return f"{bsym.output.name} = {t_s}.shape[{dim}]"
+
+
+unpack_dim = make_prim(
+    PrimIDs.UNPACK_DIM,
+    "unpack_dim",
+    _unpack_dim_meta,
+    tags=(OpTags.UNPACK_OP, OpTags.DONT_DCE),
+    python_impl=_unpack_dim_impl,
+    python_printer=_unpack_dim_printer,
+)
+
+
+def _check_dim_bucket_meta(d: Any, lo: int, hi: int) -> None:
+    return None
+
+
+def _check_dim_bucket_impl(d, lo: int, hi: int) -> None:
+    from thunder_tpu.core.baseutils import GuardFailure
+
+    if isinstance(d, NumberProxy):
+        d = d.value
+    if not (lo < d <= hi):
+        raise GuardFailure(f"Dim bucket changed: expected extent in ({lo}, {hi}], got {d}")
+
+
+check_dim_bucket = make_prim(
+    PrimIDs.CHECK_DIM_BUCKET,
+    "check_dim_bucket",
+    _check_dim_bucket_meta,
+    tags=(OpTags.GUARD_OP, OpTags.DONT_DCE),
+    python_impl=_check_dim_bucket_impl,
 )
 
 

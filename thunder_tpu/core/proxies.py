@@ -405,6 +405,11 @@ class TensorProxy(Proxy):
         # The unsharded ("logical") shape when this proxy is a dim-0 shard of
         # a distributed parameter (reference: proxies.py thunder_fsdp_padding_size etc.)
         self.unsharded_shape: Optional[tuple] = None
+        # Symbolic-values caching: {dim: (lo, hi, class_id)} for input dims
+        # lifted to bucket guards — the extents in _shape are the bucket's
+        # padded extents, and the prologue guards membership, not equality
+        # (core/bucketing.py; set during acquisition by trace_program).
+        self._symbolic_dims: Optional[dict] = None
 
     # -- metadata ------------------------------------------------------------
 
@@ -467,6 +472,7 @@ class TensorProxy(Proxy):
             sharding=changes.get("sharding", self.sharding),
         )
         p.unsharded_shape = changes.get("unsharded_shape", self.unsharded_shape)
+        p._symbolic_dims = changes.get("_symbolic_dims", self._symbolic_dims)
         return p
 
     def type_string(self) -> str:

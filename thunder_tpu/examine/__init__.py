@@ -125,6 +125,14 @@ def lint(fn: Callable, *args, executors: Optional[Any] = None, verbose: bool = T
     from thunder_tpu.extend import resolve_executors
     from thunder_tpu.transforms.common import cse, dce
 
+    # A thunder-compiled function: lint the UNDERLYING function (tracing the
+    # wrapper would trace the dispatch machinery) and report its cache state
+    # in the summary (ISSUE 2: cache observability).
+    compiled = fn if getattr(fn, "_lc_cs", None) is not None else None
+    cd = getattr(fn, "_lc_cd", None)
+    if cd is not None:
+        fn = cd.fn
+
     # The pipeline below must not raise mid-way even when THUNDER_TPU_CHECKS
     # is set globally — lint's contract is collect-everything.
     with debug_checks(False):
@@ -156,7 +164,35 @@ def lint(fn: Callable, *args, executors: Optional[Any] = None, verbose: bool = T
             print(f"lint: {len(stages)} stages verified clean ({len(extrace.bound_symbols)} symbols)")
         for d in diagnostics:
             print(d.format())
+        if compiled is not None:
+            print(format_cache_report(compiled))
     return diagnostics
+
+
+def format_cache_report(jfn: Callable) -> str:
+    """Human-readable cache summary for a compiled function: aggregate and
+    per-entry hit/miss/recompile counters plus trace/first-run seconds —
+    recompile storms become visible instead of inferred."""
+    from thunder_tpu.api import cache_info
+
+    info = cache_info(jfn)
+    lines = [
+        f"cache[{info['cache_option']}]: {info['calls']} calls, "
+        f"{info['hits']} hits ({info['fast_hits']} O(1) fast, {info['slow_hits']} "
+        f"prologue-scan), {info['misses']} misses, {info['compiles']} compiles "
+        f"({info['recompiles']} recompiles), {info['prologue_runs']} prologue runs",
+        f"  trace {info['trace_seconds']:.3f}s, first-run (incl. XLA compile) "
+        f"{info['first_run_seconds']:.3f}s, cache lookups "
+        f"{info['cache_lookup_us_total']:.0f}us total",
+    ]
+    for e in info["entries"]:
+        lines.append(
+            f"  entry {e['index']} [{e['buckets']}]: {e['hits']} hits "
+            f"({e['fast_hits']} fast), {e['prologue_runs']} prologue runs, "
+            f"{e['guard_fails']} guard fails, trace {e['trace_s']:.3f}s, "
+            f"first run {e['first_run_s']:.3f}s"
+        )
+    return "\n".join(lines)
 
 
 def get_fusions(trace: TraceCtx) -> list[tuple[str, Any]]:
@@ -175,8 +211,10 @@ _DEL_IDS = {PrimIDs.DEL}
 _NO_ALLOC_IDS = {
     PrimIDs.RETURN, PrimIDs.COMMENT, PrimIDs.PRINT,
     PrimIDs.UNPACK_TRIVIAL, PrimIDs.UNPACK_SEQUENCE, PrimIDs.UNPACK_KEY, PrimIDs.UNPACK_ATTR,
+    PrimIDs.UNPACK_DIM,
     PrimIDs.CHECK_TENSOR_SHAPE_AND_METADATA, PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE,
-    PrimIDs.CHECK_STRING_VALUE, PrimIDs.CHECK_LEN, PrimIDs.CHECK_NONE,
+    PrimIDs.CHECK_STRING_VALUE, PrimIDs.CHECK_LEN, PrimIDs.CHECK_KEYS, PrimIDs.CHECK_NONE,
+    PrimIDs.CHECK_DIM_BUCKET,
     PrimIDs.SHALLOW_COPY, PrimIDs.STOP_GRADIENT,
 }
 

@@ -522,3 +522,79 @@ def _uniform_philox(shape, minval, maxval, *, seed, offset, device, dtype):
 
 
 _reg(PrimIDs.UNIFORM_PHILOX, _uniform_philox)
+
+
+# =============================================================================
+# Bucketed staging (cache="symbolic values", core/bucketing.py)
+#
+# One XLA executable serves a whole shape bucket: marked input dims are
+# zero-padded up to the bucket ceiling here, at the jax.jit boundary, and
+# outputs are cropped back by the dispatcher (api._run_entry). The padded
+# buffers are dispatch-time temporaries, so they are DONATED to XLA (off-CPU):
+# the executable reuses their memory instead of copying.
+# =============================================================================
+
+
+def _donation_active() -> bool:
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+def stage_bucketed(trace_callable, donate_leaves: Sequence[int]):
+    """jax.jit a trace callable whose ``donate_leaves`` argument positions
+    receive freshly padded (dispatch-owned) buffers. Donation is skipped on
+    CPU, where jax does not implement it (and would warn per call)."""
+    if _donation_active() and donate_leaves:
+        return jax.jit(trace_callable, donate_argnums=tuple(donate_leaves))
+    return jax.jit(trace_callable)
+
+
+def pad_to_bucket(inps: list, sym_spec) -> list:
+    """Zero-pad marked dims of the (jax) input leaves up to their bucket
+    ceilings. Always returns buffers safe to donate for marked leaves: a leaf
+    already at the ceiling is copied, so the caller's array is never donated
+    out from under it."""
+    donating = _donation_active()
+    out = list(inps)
+    for li, dims in sym_spec.marks.items():
+        x = out[li]
+        widths = [(0, 0)] * x.ndim
+        padded = False
+        for d, (_lo, hi, _cid) in dims.items():
+            delta = int(hi) - int(x.shape[d])
+            if delta > 0:
+                widths[d] = (0, delta)
+                padded = True
+        if padded:
+            out[li] = jnp.pad(x, widths)
+        elif donating:
+            out[li] = jnp.array(x, copy=True)
+    return out
+
+
+def crop_to_extents(out, sym_spec, true_extents: dict):
+    """Slice padded output dims back to the call's true extents, per the
+    provenance crop plan (transforms/padmask.py): each listed flat output
+    leaf is sliced exactly on its tracked dims. The plan is always derived —
+    from the masked trace, or re-analyzed after grad/autocast transforms —
+    so no shape-coincidence guessing happens here."""
+    from thunder_tpu.core.pytree import tree_flatten, tree_unflatten
+
+    if not sym_spec.crop_plan:
+        return out
+    flat, spec = tree_flatten(out)
+
+    def slice_dim(x, d, n):
+        if int(x.shape[d]) == int(n):
+            return x
+        ix = [slice(None)] * x.ndim
+        ix[d] = slice(0, int(n))
+        return x[tuple(ix)]
+
+    for i, dims in sym_spec.crop_plan:
+        if i < len(flat) and isinstance(flat[i], jax.Array):
+            for d, cid in dims.items():
+                flat[i] = slice_dim(flat[i], d, true_extents[cid])
+    return tree_unflatten(spec, flat)

@@ -32,6 +32,7 @@ _PASSTHROUGH_IDS = {
     PrimIDs.UNPACK_SEQUENCE,
     PrimIDs.UNPACK_KEY,
     PrimIDs.UNPACK_ATTR,
+    PrimIDs.UNPACK_DIM,  # printer emits `d = t.shape[i]`, any backend
     PrimIDs.TENSOR_CONSTANT,  # printer emits a _call_ctx binding, any backend
 }
 

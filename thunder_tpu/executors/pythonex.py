@@ -22,6 +22,7 @@ _guard_ids = (
     PrimIDs.CHECK_LEN,
     PrimIDs.CHECK_KEYS,
     PrimIDs.CHECK_NONE,
+    PrimIDs.CHECK_DIM_BUCKET,
 )
 
 for pid in _guard_ids:

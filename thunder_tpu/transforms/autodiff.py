@@ -723,6 +723,8 @@ _SKIP_IDS = {
     PrimIDs.CHECK_STRING_VALUE,
     PrimIDs.CHECK_LEN,
     PrimIDs.CHECK_NONE,
+    PrimIDs.UNPACK_DIM,
+    PrimIDs.CHECK_DIM_BUCKET,
 }
 
 
