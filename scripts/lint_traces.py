@@ -13,6 +13,10 @@ Exit status is non-zero if any ERROR-severity diagnostic is found.
 Usage:
     python scripts/lint_traces.py            # all programs
     python scripts/lint_traces.py gpt        # substring-filter by name
+    python scripts/lint_traces.py --events LOG.jsonl
+        # replay an observability event log (THUNDER_TPU_EVENTS /
+        # jit(events=...)): validates the JSONL schema and flags recompile
+        # storms (thunder_tpu.analysis.events; docs/observability.md)
 """
 
 from __future__ import annotations
@@ -86,8 +90,44 @@ def _grad_workloads():
     ]
 
 
+def _replay(path: str, storm_threshold: int) -> int:
+    from thunder_tpu.analysis import Severity
+    from thunder_tpu.analysis.events import format_replay, replay_events
+
+    summary, diags = replay_events(path, storm_threshold=storm_threshold)
+    print(format_replay(summary, diags))
+    n_errors = sum(1 for d in diags if d.severity >= Severity.ERROR)
+    print(f"\nlint_traces --events: {n_errors} error(s), "
+          f"{sum(1 for d in diags if d.severity == Severity.WARNING)} warning(s)")
+    return 1 if n_errors else 0
+
+
+_USAGE = "usage: lint_traces.py [pattern] | --events <log.jsonl> [--storm-threshold N]"
+
+
 def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    if "--events" in argv:
+        i = argv.index("--events")
+        path = argv[i + 1] if i + 1 < len(argv) and not argv[i + 1].startswith("--") else None
+        storm = 4
+        if "--storm-threshold" in argv:
+            j = argv.index("--storm-threshold")
+            try:
+                storm = int(argv[j + 1])
+            except (IndexError, ValueError):
+                print(_USAGE, file=sys.stderr)
+                return 2
+        if path is None:
+            print(_USAGE, file=sys.stderr)
+            return 2
+        try:
+            return _replay(path, storm)
+        except OSError as e:
+            print(f"lint_traces --events: cannot read {path!r}: {e}", file=sys.stderr)
+            return 2
+
     pattern = argv[0] if argv else ""
 
     from thunder_tpu.analysis import Severity, TraceVerificationError

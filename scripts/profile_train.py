@@ -1,7 +1,13 @@
 """Profile the bench training step on the real TPU and dump per-op times.
 
 Usage: python scripts/profile_train.py [outdir]
-Writes an xplane profile then parses it with xprof into a per-HLO-op table.
+
+Thin driver over ``thunder_tpu.profile`` (observability/profile.py): brackets
+3 warm steps with jax.profiler StepTraceAnnotations and writes an xplane
+profile; parse per-HLO-op self-times with xprof (``hlo_stats``). Run with
+``THUNDER_TPU_ANNOTATE_TRACES=1`` to stamp trace-line + pass provenance into
+HLO metadata so profiler rows map back to BoundSymbols
+(docs/observability.md).
 """
 from __future__ import annotations
 
@@ -13,30 +19,35 @@ sys.path.insert(0, ".")
 
 def main():
     outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/prof_train"
-    import jax
-    import numpy as np
 
     from bench import build_train, TRAIN_B, TRAIN_T
     from thunder_tpu.api import _ensure_runtime
+    from thunder_tpu.observability.profile import profile
 
     _ensure_runtime()
-    jfn, flat_params, idx, tgt, init_s, trace_s, stage_s = build_train("open_llama_3b", TRAIN_B, TRAIN_T)
+    jfn, flat_params, idx, tgt, init_s, trace_s, stage_s = build_train(
+        "open_llama_3b", TRAIN_B, TRAIN_T
+    )
 
     t0 = time.perf_counter()
     flat_params, loss = jfn(flat_params, idx, tgt)
     loss.block_until_ready()
     print(f"compile+first: {time.perf_counter()-t0:.1f}s", file=sys.stderr)
 
-    # warm
-    for _ in range(2):
-        flat_params, loss = jfn(flat_params, idx, tgt)
-    loss.block_until_ready()
+    # Params are donated: thread them through a closure so every profiled
+    # step consumes the previous step's buffers, exactly like the train loop.
+    state = {"p": flat_params}
 
-    with jax.profiler.trace(outdir):
-        for _ in range(3):
-            flat_params, loss = jfn(flat_params, idx, tgt)
-        loss.block_until_ready()
-    print(f"profile written to {outdir}", file=sys.stderr)
+    def step():
+        state["p"], loss = jfn(state["p"], idx, tgt)
+        return loss
+
+    res = profile(step, trace_dir=outdir, steps=3, warmup=2)
+    print(
+        f"profile written to {res['trace_dir']} "
+        f"(avg step {res['avg_s']:.4f}s, profiler={'ok' if res['profiler'] else 'WALL-CLOCK ONLY'})",
+        file=sys.stderr,
+    )
 
 
 if __name__ == "__main__":

@@ -235,3 +235,27 @@ class TestGradVmapComposition:
         per_sample(xs)
         cs = thunder_tpu.compile_stats(per_sample)
         assert cs.cache_misses == 1 and cs.cache_hits == 1
+
+
+class TestInputMutationRejected:
+    """ADVICE r5 #2: vmap/jvp re-stage without the jit mutation epilogue, so
+    an input-mutating function must fail loudly instead of silently dropping
+    its writes (matching the grad path's NotImplementedError)."""
+
+    def test_vmap_rejects_container_mutation(self):
+        def f(d):
+            d["k"] = ttorch.tanh(d["x"])
+            return ttorch.sum(d["k"])
+
+        xs = {"x": np.ones((3, 4), np.float32)}
+        with pytest.raises(NotImplementedError, match="mutates its inputs"):
+            thunder_tpu.vmap(f)(xs)
+
+    def test_jvp_rejects_inplace_tensor_mutation(self):
+        def f(x):
+            ttorch.add_(x, 1.0)  # in-place update of an INPUT tensor
+            return ttorch.sum(x)
+
+        x = np.ones((4,), np.float32)
+        with pytest.raises(NotImplementedError, match="mutates its inputs"):
+            thunder_tpu.jvp(f, (x,), (x,))

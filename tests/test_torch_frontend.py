@@ -453,6 +453,38 @@ class TestSeqBucketing:
         # so only its SHAPE is asserted above
         torch.testing.assert_close(seq_out, want_seq, rtol=2e-4, atol=2e-5)
 
+    def test_transient_probe_failure_retries(self):
+        """ADVICE r5 #4: a shape probe that fails TRANSIENTLY (e.g. a lazy
+        init raising under FakeTensorMode on the first call only) must not
+        pin plan=None — the next call retries and caches the real plan."""
+        torch.manual_seed(3)
+        # External flag: the probe restores module state after itself, so a
+        # genuinely transient failure must clear OUTSIDE the module.
+        flag = {"fail": True}
+
+        class LazyFail(nn.Module):
+            def __init__(self, vocab=32, dim=16):
+                super().__init__()
+                self.wte = nn.Embedding(vocab, dim)
+                self.head = nn.Linear(dim, vocab, bias=False)
+
+            def forward(self, idx):
+                from torch._subclasses.fake_tensor import FakeTensor
+
+                x = self.wte(idx)
+                if flag["fail"] and isinstance(x, FakeTensor):
+                    flag["fail"] = False
+                    raise RuntimeError("transient lazy init under fake mode")
+                return self.head(x)
+
+        tm = thunder_tpu.jit(LazyFail(), seq_bucket=64, executors=["jax"])
+        idx = torch.randint(0, 32, (2, 50))
+        out = tm(idx)
+        assert out.shape == (2, 50, 32)
+        tm(idx)
+        cache = getattr(tm, "_seq_crop_cache", {})
+        assert cache and all(v is not None for v in cache.values()), cache
+
     def test_bucketed_grads_match(self):
         torch.manual_seed(1)
         m_ref = self._tiny_causal()

@@ -39,6 +39,8 @@ from thunder_tpu.common import (  # noqa: F401
     ThunderSharpEdgeError,
     ThunderSharpEdgeWarning,
 )
+from thunder_tpu import monitor  # noqa: F401  # metrics facade (docs/observability.md)
+from thunder_tpu.observability.profile import profile  # noqa: F401
 
 # Legacy entry point (reference parity: thunder.compile, thunder/__init__.py:655
 # — deprecated there in favor of jit; same here). Excluded from __all__ so
@@ -52,6 +54,6 @@ __all__ = [
     "cache_misses", "cache_info", "set_execution_callback_file",
     "CACHE_OPTIONS", "SHARP_EDGES_OPTIONS",
     "ThunderSharpEdgeError", "ThunderSharpEdgeWarning",
-    "dtypes", "devices",
+    "dtypes", "devices", "monitor", "profile",
 ]
 

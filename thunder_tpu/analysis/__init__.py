@@ -33,6 +33,7 @@ from thunder_tpu.analysis.diagnostics import (  # noqa: F401
     max_severity,
 )
 from thunder_tpu.analysis.context import VerifyContext, pass_name_of  # noqa: F401
+from thunder_tpu.analysis.events import format_replay, replay_events  # noqa: F401
 from thunder_tpu.analysis.registry import (  # noqa: F401
     Rule,
     all_rules,

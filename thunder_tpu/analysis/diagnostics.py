@@ -57,8 +57,7 @@ def attach_trace_lines(diagnostics: Sequence[Diagnostic], trace) -> None:
         if d.bsym_index is None or d.trace_line is not None:
             continue
         try:
-            bsym = trace.bound_symbols[d.bsym_index]
-            d.trace_line = "; ".join(s.strip() for s in bsym.python(indent=0))
+            d.trace_line = trace.bound_symbols[d.bsym_index].one_line()
         except Exception:
             pass
 

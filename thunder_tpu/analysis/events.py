@@ -1,0 +1,231 @@
+"""Event-log replay: validate and analyze an observability JSONL log.
+
+The offline half of the event pipeline (``observability/events.py`` writes,
+this module reads): ``scripts/lint_traces.py --events <path>`` replays a log
+captured under ``THUNDER_TPU_EVENTS``/``jit(events=...)`` and flags
+
+- schema violations (unparseable lines, unknown kinds, missing fields,
+  wrong schema version) — the golden-schema contract tests and CI both key
+  on this;
+- **recompile storms**: one function compiling more than
+  ``storm_threshold`` times (the PR 2 dispatch work exists precisely so
+  steady-state traffic compiles once per shape bucket — more means guards
+  are churning or bucketing is misconfigured);
+- unbalanced compile brackets (a ``compile_start`` whose ``compile_end``
+  never arrived: a crash or exception mid-compile).
+
+Findings reuse :class:`~thunder_tpu.analysis.diagnostics.Diagnostic`
+(severity-gated exactly like trace-verifier findings), so the lint driver
+treats both uniformly.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from thunder_tpu.analysis.diagnostics import Diagnostic, Severity
+
+# kind -> required fields. The writer guarantees these; the replayer checks
+# them so downstream dashboards can rely on the shape of every record.
+SCHEMA: dict[str, frozenset] = {
+    "cache_miss": frozenset({"fn", "call"}),
+    "compile_start": frozenset({"compile_id", "fn", "cache_option", "call"}),
+    "compile_end": frozenset({"compile_id", "fn", "ms", "n_bsyms"}),
+    "pass": frozenset({"compile_id", "name", "ms", "n_bsyms", "trace"}),
+    "bucket_select": frozenset({"compile_id", "buckets", "marks"}),
+    "sharp_edge": frozenset({"message", "policy"}),
+    "nan_watch": frozenset({"value_kind", "symbol", "bsym_index", "line", "provenance"}),
+    "profile_start": frozenset({"dir", "steps"}),
+    "profile_stop": frozenset({"steps", "total_s", "avg_s", "profiler"}),
+}
+_COMMON = frozenset({"v", "ts", "seq", "kind"})
+
+
+def replay_events(
+    path: str,
+    *,
+    storm_threshold: int = 4,
+    strict_kinds: bool = False,
+) -> tuple[dict, list[Diagnostic]]:
+    """Parse + validate ``path``; return ``(summary, diagnostics)``.
+
+    ``summary``: event/kind counts, per-function compile counts, per-pass
+    total milliseconds, bucket selections, sharp-edge messages.
+    ``storm_threshold``: compiles per function above which a recompile-storm
+    ERROR fires. ``strict_kinds`` upgrades unknown kinds to errors (default:
+    warning, so log readers stay forward-compatible)."""
+    diags: list[Diagnostic] = []
+    kinds: dict[str, int] = {}
+    compiles_by_fn: dict[str, int] = {}
+    exact_compiles_by_fn: dict[str, int] = {}
+    recompiles_by_fn: dict[str, int] = {}
+    pass_ms: dict[str, float] = {}
+    seq_bucket_compiles_by_fn: dict[str, int] = {}
+    open_compiles: dict[Any, str] = {}
+    cache_option_by_cid: dict[Any, str] = {}
+    bucket_by_cid: dict[Any, str] = {}
+    bucket_compile_counts: dict[tuple, int] = {}  # (fn, bucket desc) -> compiles
+    buckets: list[str] = []
+    sharp_edges: list[str] = []
+    n_lines = 0
+
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            n_lines += 1
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                diags.append(Diagnostic(
+                    rule="events.malformed-line", severity=Severity.ERROR,
+                    message=f"line {lineno}: not valid JSON ({e})",
+                ))
+                continue
+            if not isinstance(rec, dict) or "kind" not in rec:
+                diags.append(Diagnostic(
+                    rule="events.malformed-record", severity=Severity.ERROR,
+                    message=f"line {lineno}: not an event object (no 'kind')",
+                ))
+                continue
+            if rec.get("v") != 1:
+                diags.append(Diagnostic(
+                    rule="events.schema-version", severity=Severity.ERROR,
+                    message=f"line {lineno}: unsupported schema version {rec.get('v')!r}",
+                ))
+                continue
+            kind = rec["kind"]
+            kinds[kind] = kinds.get(kind, 0) + 1
+            required = SCHEMA.get(kind)
+            if required is None:
+                diags.append(Diagnostic(
+                    rule="events.unknown-kind",
+                    severity=Severity.ERROR if strict_kinds else Severity.WARNING,
+                    message=f"line {lineno}: unknown event kind {kind!r}",
+                ))
+                continue
+            missing = required - set(rec)
+            if missing:
+                diags.append(Diagnostic(
+                    rule="events.missing-fields", severity=Severity.ERROR,
+                    message=f"line {lineno}: {kind} event missing fields {sorted(missing)}",
+                ))
+                continue
+
+            if kind == "compile_start":
+                fn = str(rec["fn"])
+                compiles_by_fn[fn] = compiles_by_fn.get(fn, 0) + 1
+                open_compiles[rec["compile_id"]] = fn
+                cache_option_by_cid[rec["compile_id"]] = str(rec["cache_option"])
+            elif kind == "compile_end":
+                fn = str(rec["fn"])
+                cid = rec["compile_id"]
+                open_compiles.pop(cid, None)
+                if rec.get("recompile"):
+                    recompiles_by_fn[fn] = recompiles_by_fn.get(fn, 0) + 1
+                # Storm accounting distinguishes compile CLASSES: one compile
+                # per shape bucket is the documented healthy steady state for
+                # cache="symbolic values" (symbolic compiles count per
+                # (fn, bucket) — repeats of the SAME bucket are the storm)
+                # and for the module frontend's seq_bucket (bucket identity
+                # is not in the log, so those get a higher threshold);
+                # exact-shape compiles count per fn.
+                if rec.get("symbolic"):
+                    bkey = (fn, bucket_by_cid.get(cid, "?"))
+                    bucket_compile_counts[bkey] = bucket_compile_counts.get(bkey, 0) + 1
+                elif cache_option_by_cid.get(cid, "").endswith("+seq_bucket"):
+                    seq_bucket_compiles_by_fn[fn] = seq_bucket_compiles_by_fn.get(fn, 0) + 1
+                else:
+                    exact_compiles_by_fn[fn] = exact_compiles_by_fn.get(fn, 0) + 1
+            elif kind == "pass":
+                if rec["ms"] is not None:
+                    pass_ms[rec["name"]] = pass_ms.get(rec["name"], 0.0) + float(rec["ms"])
+            elif kind == "bucket_select":
+                buckets.append(str(rec["buckets"]))
+                bucket_by_cid[rec["compile_id"]] = str(rec["buckets"])
+            elif kind == "sharp_edge":
+                sharp_edges.append(str(rec["message"]))
+
+    for fn, n in sorted(exact_compiles_by_fn.items()):
+        if n > storm_threshold:
+            diags.append(Diagnostic(
+                rule="events.recompile-storm", severity=Severity.ERROR,
+                message=(
+                    f"{fn!r} compiled {n} times for exact shapes (threshold "
+                    f"{storm_threshold}) — guards are churning; consider "
+                    f"cache='symbolic values'"
+                ),
+                hint="thunder_tpu.cache_info(fn) shows per-entry guard fails",
+            ))
+    for fn, n in sorted(seq_bucket_compiles_by_fn.items()):
+        # Bucket identity isn't in the module-frontend log, so distinct
+        # buckets and same-bucket churn are indistinguishable here: flag only
+        # well past any plausible bucket count, and as a WARNING.
+        if n > storm_threshold * 4:
+            diags.append(Diagnostic(
+                rule="events.recompile-storm", severity=Severity.WARNING,
+                message=(
+                    f"{fn!r} (module, seq_bucket) compiled {n} times — more "
+                    f"than {storm_threshold * 4} sequence buckets is unusual; "
+                    f"check for value-guard churn"
+                ),
+                hint="the module warns in-process on repeated value-guard "
+                     "misses; thunder_tpu.cache_info(tm) shows entry counts",
+            ))
+    for (fn, desc), n in sorted(bucket_compile_counts.items()):
+        if n > 2:
+            diags.append(Diagnostic(
+                rule="events.recompile-storm", severity=Severity.ERROR,
+                message=(
+                    f"{fn!r} compiled shape bucket {desc} {n} times — one "
+                    f"compile per bucket is steady state; repeats mean value "
+                    f"guards or marks are churning"
+                ),
+                hint="check symbolic_dims/buckets configuration; "
+                     "thunder_tpu.cache_info(fn) shows per-entry guard fails",
+            ))
+    for cid, fn in open_compiles.items():
+        diags.append(Diagnostic(
+            rule="events.unclosed-compile", severity=Severity.WARNING,
+            message=f"compile {cid} of {fn!r} has no compile_end (crashed mid-compile?)",
+        ))
+
+    summary = {
+        "path": path,
+        "lines": n_lines,
+        "kinds": kinds,
+        "compiles_by_fn": compiles_by_fn,
+        "exact_compiles_by_fn": exact_compiles_by_fn,
+        "seq_bucket_compiles_by_fn": seq_bucket_compiles_by_fn,
+        "bucket_compiles": {f"{fn}: {d}": n for (fn, d), n in sorted(bucket_compile_counts.items())},
+        "recompiles_by_fn": recompiles_by_fn,
+        "pass_ms_total": {k: round(v, 3) for k, v in sorted(pass_ms.items())},
+        "bucket_selects": buckets,
+        "sharp_edges": sharp_edges,
+    }
+    return summary, diags
+
+
+def format_replay(summary: dict, diags: list[Diagnostic]) -> str:
+    """Human-readable replay report for the lint driver."""
+    lines = [
+        f"events: {summary['lines']} records from {summary['path']}",
+        "  kinds: " + ", ".join(f"{k}={v}" for k, v in sorted(summary["kinds"].items())),
+    ]
+    if summary["compiles_by_fn"]:
+        lines.append("  compiles: " + ", ".join(
+            f"{fn}×{n}" for fn, n in sorted(summary["compiles_by_fn"].items())
+        ))
+    if summary["pass_ms_total"]:
+        lines.append("  pass time (ms): " + ", ".join(
+            f"{k}={v}" for k, v in summary["pass_ms_total"].items()
+        ))
+    if summary["bucket_selects"]:
+        lines.append(f"  bucket selects: {len(summary['bucket_selects'])}")
+    if summary["sharp_edges"]:
+        lines.append(f"  sharp edges: {len(summary['sharp_edges'])}")
+    for d in diags:
+        lines.append("  " + d.format().replace("\n", "\n  "))
+    return "\n".join(lines)

@@ -52,6 +52,8 @@ from thunder_tpu.executors import flashex, pallasex  # higher-priority kernel ex
 from thunder_tpu.executors import quantex  # opt-in int8 executor (registered, not default)  # noqa: F401
 from thunder_tpu.executors.passes import del_last_used, transform_for_execution
 from thunder_tpu.extend import resolve_executors
+from thunder_tpu.observability import events as obs_events
+from thunder_tpu.observability import metrics as obsm
 from thunder_tpu.transforms.common import cse, dce
 from thunder_tpu.transforms.rng import RNG_TAG, functionalize_rng_ops
 
@@ -390,14 +392,14 @@ def trace_program(
 
             result = resolve_inplace_tree(result)
 
-        muts: list = []
-        extras: list = []
-        if record_input_mutations:
-            muts, extras = _collect_input_mutations(
-                proxied_args, proxied_kwargs, pristine_args, pristine_kwargs, tensor_leaves
-            )
+        # Mutations are always DETECTED (so every staging path — jit, grad,
+        # vmap/jvp — can see them on comp_trc._input_mutations); only the
+        # jit() path (record_input_mutations) REPLAYS them via the epilogue.
+        muts, extras = _collect_input_mutations(
+            proxied_args, proxied_kwargs, pristine_args, pristine_kwargs, tensor_leaves
+        )
         comp_trc._input_mutations = muts
-        if muts:
+        if muts and record_input_mutations:
             from thunder_tpu.common import sharp_edge
 
             kinds = sorted({m[0] for m in muts})
@@ -448,18 +450,34 @@ def _compile_entry(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict)
     # provenance stamping (wrap_in_trace_provenance/mark in core/trace.py)
     # verifies its output, so a violation names the pass that introduced it.
     from thunder_tpu.core.trace import debug_checks
+    from thunder_tpu.observability import events
 
-    with debug_checks(cd.compile_options.get("debug_checks")):
+    with debug_checks(cd.compile_options.get("debug_checks")), \
+            events.compile_scope(getattr(cd, "_event_log", None)) as compile_id:
+        events.emit_event(
+            "compile_start",
+            compile_id=compile_id,
+            fn=getattr(cd.fn, "__name__", repr(cd.fn)),
+            cache_option=cd.cache_option.name.lower(),
+            call=cs.calls,
+        )
         if cd.cache_option is CACHE_OPTIONS.SYMBOLIC_VALUES:
             sym_spec = _symbolic_spec_for_call(cd, cs, args, kwargs)
             if sym_spec is not None:
+                events.emit_event(
+                    "bucket_select", compile_id=compile_id,
+                    buckets=sym_spec.describe(),
+                    marks={str(li): sorted(d.keys()) for li, d in sym_spec.marks.items()},
+                )
                 pargs, pkwargs = _pad_example(args, kwargs, sym_spec)
-                return _compile_entry_checked(cd, cs, pargs, pkwargs, sym_spec)
-        return _compile_entry_checked(cd, cs, args, kwargs, None)
+                return _compile_entry_checked(cd, cs, pargs, pkwargs, sym_spec,
+                                              compile_id=compile_id)
+        return _compile_entry_checked(cd, cs, args, kwargs, None, compile_id=compile_id)
 
 
 def _compile_entry_checked(
-    cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict, sym_spec
+    cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict, sym_spec,
+    compile_id: Optional[int] = None,
 ) -> CacheEntry:
     import jax
 
@@ -540,6 +558,19 @@ def _compile_entry_checked(
 
     extrace = transform_for_execution(comp_trc, cd.executors_list)
     computation_traces.append(extrace)
+
+    # Per-op instrumentation (observability/instrument.py): bracket every
+    # value-producing bsym with host pre/post hooks. Runs after claiming (so
+    # records carry the executor) and before del_last_used (so dels land
+    # after the hooks that consume the values). Instrumented entries execute
+    # UNSTAGED — the hooks are host side effects XLA cannot stage.
+    instrument_hooks = _resolve_instrument_hooks(cd)
+    if instrument_hooks:
+        from thunder_tpu.observability.instrument import instrument_for_execution
+
+        extrace = instrument_for_execution(extrace, instrument_hooks)
+        computation_traces.append(extrace)
+
     extrace = del_last_used(extrace)
     computation_traces.append(extrace)
 
@@ -577,7 +608,7 @@ def _compile_entry_checked(
 
     needs_rng = bool(extrace.tags.get(RNG_TAG))
     device_sync = _has_tag_in_trace(extrace, OpTags.DEVICE_SYNC_OP)
-    if cd.disable_jit_staging or device_sync:
+    if cd.disable_jit_staging or device_sync or instrument_hooks:
         computation_fn = trace_callable
     elif sym_spec is not None:
         # Bucketed staging: padded input buffers are dispatch-owned
@@ -607,11 +638,62 @@ def _compile_entry_checked(
     entry.stats.trace_s = (timer_ns() - build_start) / 1e9
     cs.trace_seconds += entry.stats.trace_s
 
+    # Observability: compile-side metrics + the compile_end event carrying
+    # the executor-claim breakdown and static collective traffic of the
+    # final execution trace (executors/passes.py stamps them into tags).
+    from thunder_tpu.observability import events
+
+    claims = extrace.tags.get("claim_breakdown") or {}
+    collective_bytes = int(extrace.tags.get("collective_bytes") or 0)
+    if obsm.enabled():
+        obsm.COMPILES.inc()
+        if cs.compile_count > 1:
+            obsm.RECOMPILES.inc()
+        if sym_spec is not None:
+            obsm.BUCKET_COMPILES.inc()
+        obsm.COMPILE_MS.observe(entry.stats.trace_s * 1e3)
+        for ex_name, n in claims.items():
+            obsm.CLAIMED_BSYMS.inc(n, executor=ex_name)
+        if collective_bytes:
+            obsm.COLLECTIVE_BYTES.inc(collective_bytes)
+    events.emit_compile_end(
+        compile_id,
+        getattr(cd.fn, "__name__", repr(cd.fn)),
+        entry.stats.trace_s * 1e3,
+        extrace,
+        symbolic=sym_spec is not None,
+        recompile=cs.compile_count > 1,
+        staged=computation_fn is not trace_callable,
+    )
+
     cs.last_traces = computation_traces
     cs.last_prologue_traces = plg_traces
     if cd.cache_option is not CACHE_OPTIONS.NO_CACHING:
         cs.cache_entries.append(entry)
     return entry
+
+
+def _resolve_instrument_hooks(cd: CompileData) -> tuple:
+    """Hooks from jit(debug_watch=..., instrument=...), resolved ONCE per
+    compiled function (not per entry) and stashed on cd: every cache entry
+    of the function shares the same hook instances, so an OpTimer created
+    from ``instrument="time"`` accumulates across shape specializations and
+    ``instrument_reports`` sees all of it. Empty tuple (the common case)
+    means no instrumentation pass runs and the entry stages whole under
+    XLA — observability-off costs nothing."""
+    hooks = getattr(cd, "_instrument_hooks", None)
+    if hooks is not None:
+        return hooks
+    dw = cd.compile_options.get("debug_watch")
+    ins = cd.compile_options.get("instrument")
+    if not dw and ins is None:
+        cd._instrument_hooks = ()
+        return ()
+    from thunder_tpu.observability.instrument import resolve_hooks
+
+    hooks = resolve_hooks(dw, ins)
+    cd._instrument_hooks = hooks
+    return hooks
 
 
 # Trace-dump-and-edit hook (reference: thunder/__init__.py:168-170 +
@@ -1030,6 +1112,9 @@ def jit(
     sharp_edges: str | SHARP_EDGES_OPTIONS = SHARP_EDGES_OPTIONS.ALLOW,
     disable_jit_staging: bool = False,
     debug_checks: Optional[bool] = None,
+    events: Optional[str] = None,
+    debug_watch: Optional[str] = None,
+    instrument: Any = None,
     **compile_options,
 ) -> Callable:
     """Compile ``fn`` for TPU execution (reference: thunder/__init__.py `jit:299`).
@@ -1052,6 +1137,20 @@ def jit(
     varying, "all", a ``{tensor_leaf_index: (dims...)}`` dict, or a dim
     tuple) and ``buckets`` (e.g. ``{"batch": "pow2", "seq": 128}``; also the
     ``THUNDER_TPU_BUCKETS`` env var). See docs/caching.md.
+
+    Observability (docs/observability.md):
+
+    - ``events="<path>"`` writes this function's compile/cache/bucket events
+      as JSONL to ``path`` (overriding the process-wide ``THUNDER_TPU_EVENTS``
+      log for this function);
+    - ``debug_watch="nan"`` (or ``"inf"``/``"nan+inf"``) instruments every
+      bound symbol and raises :class:`~thunder_tpu.observability.instrument.
+      NaNWatchError` — with the offending BoundSymbol name, generated trace
+      line, and pass provenance — the moment an output turns non-finite;
+    - ``instrument`` takes ``"time"``, ``"memory"``, a custom
+      ``InstrumentationHook``, a bare ``fn(rec, outputs)`` callable, or a
+      list of those. Instrumented entries run unstaged (op-by-op); with
+      neither option the entry stages whole under XLA as usual.
     """
     if fn is None:
         return functools.partial(
@@ -1061,6 +1160,9 @@ def jit(
             sharp_edges=sharp_edges,
             disable_jit_staging=disable_jit_staging,
             debug_checks=debug_checks,
+            events=events,
+            debug_watch=debug_watch,
+            instrument=instrument,
             **compile_options,
         )
 
@@ -1083,12 +1185,17 @@ def jit(
     except ImportError:
         pass
     if _torch is not None and isinstance(fn, _torch.nn.Module):
+        if debug_watch or instrument is not None:
+            raise NotImplementedError(
+                "debug_watch/instrument are not yet supported on the torch "
+                "nn.Module frontend — jit the functional forward instead"
+            )
         from thunder_tpu.frontend.module import thunder_module
 
         return thunder_module(
             fn, executors=executors, cache=cache, sharp_edges=sharp_edges,
             disable_jit_staging=disable_jit_staging, debug_checks=debug_checks,
-            **compile_options
+            events=events, **compile_options
         )
 
     cache_option = resolve_cache_option(cache)
@@ -1109,8 +1216,13 @@ def jit(
         cache_option=cache_option,
         sharp_edges=resolve_sharp_edges_option(sharp_edges),
         disable_jit_staging=disable_jit_staging,
-        compile_options=dict(compile_options, debug_checks=debug_checks),
+        compile_options=dict(
+            compile_options, debug_checks=debug_checks,
+            debug_watch=debug_watch, instrument=instrument,
+        ),
     )
+    if events:
+        cd._event_log = obs_events.log_for_path(events)
     cs = CompileStats()
 
     @functools.wraps(fn)
@@ -1125,6 +1237,7 @@ def jit(
         flat_inps = None
         prepared = None
         key = None
+        hit_kind = "hit"
         if co in (CACHE_OPTIONS.CONSTANT_VALUES, CACHE_OPTIONS.SYMBOLIC_VALUES):
             flat, treedef = tree_flatten((args, kwargs))
             key = (treedef, _leaf_meta(flat))
@@ -1139,6 +1252,7 @@ def jit(
             cs.prologue_runs += 1
             entry.stats.prologue_runs += 1
             flat_inps = entry.prologue_fn(*args, **kwargs)
+            hit_kind = "same_input"
         elif key is not None and cs.cache_entries:
             # Two-tier dispatch. Tier 1: O(1) key hit — (tree structure, per
             # leaf rank/shape/dtype/device/value metadata) → entry, learned on
@@ -1155,6 +1269,7 @@ def jit(
                     flat_inps = leaves
                     cs.fast_hits += 1
                     entry.stats.fast_hits += 1
+                    hit_kind = "fast"
                 else:
                     prepared = None
             if entry is None:
@@ -1163,6 +1278,7 @@ def jit(
                 entry, flat_inps, prepared = _probe_entries(cs, args, kwargs)
                 if entry is not None:
                     cs.slow_hits += 1
+                    hit_kind = "slow"
                     if len(cs.fast_cache) > _FAST_CACHE_MAX:
                         cs.fast_cache.clear()
                     cs.fast_cache[key] = entry
@@ -1176,11 +1292,28 @@ def jit(
             if entry.epilogue_fn is not None:
                 result = entry.epilogue_fn(args, kwargs, flat_inps, result)
             cs.last_trace_host_stop = timer_ns()
+            if obsm.enabled():
+                # Single flag check on the warm path when metrics are off
+                # (BENCHMARKS.md budgets: <1% off, <5% on).
+                obsm.CACHE_HITS.inc(kind=hit_kind)
+                obsm.CACHE_LOOKUP_US.observe(
+                    (cs.last_trace_cache_stop - cs.last_trace_cache_start) / 1e3
+                )
+                obsm.DISPATCH_US.observe(
+                    (cs.last_trace_host_stop - cs.last_trace_host_start) / 1e3
+                )
             return result
         cs.last_trace_cache_stop = timer_ns()
         cs.cache_lookup_ns += cs.last_trace_cache_stop - cs.last_trace_cache_start
 
         cs.cache_misses += 1
+        if obsm.enabled():
+            obsm.CACHE_MISSES.inc()
+        _obs_log = getattr(cd, "_event_log", None) or obs_events.active_log()
+        if _obs_log is not None:
+            _obs_log.emit(
+                "cache_miss", fn=getattr(cd.fn, "__name__", repr(cd.fn)), call=cs.calls
+            )
         entry = _compile_entry(cd, cs, args, kwargs)
         if key is not None:
             if len(cs.fast_cache) > _FAST_CACHE_MAX:
@@ -1339,6 +1472,17 @@ def _staged_flat_fn(fn: Callable, args: tuple, kwargs: Optional[dict] = None,
     from thunder_tpu.executors.passes import transform_for_execution
 
     _, comp = trace_program(fn, args, kwargs or {})
+    if getattr(comp, "_input_mutations", None):
+        # ADVICE r5 #2: this path re-stages without the jit epilogue, so a
+        # function that mutates its inputs would silently lose those writes
+        # under vmap/jvp — fail loudly like the grad path does.
+        kinds = sorted({m[0] for m in comp._input_mutations})
+        raise NotImplementedError(
+            f"the traced function mutates its inputs ({', '.join(kinds)}), "
+            "which cannot be combined with vmap/jvp re-staging (the mutation "
+            "epilogue does not run on this path) — make the function pure or "
+            "apply updates outside it"
+        )
     comp = cse(dce(comp))
     for tt in trace_transforms:
         comp = tt(comp)

@@ -98,6 +98,14 @@ def sharp_edge(msg: str) -> None:
     if _sharp_edges_suppressed.get():
         return
     policy = _sharp_edges_policy.get()
+    # Observability tap (before the ALLOW early-return: the event log wants
+    # every sharp edge, the policy only governs warn/raise behavior).
+    from thunder_tpu.observability import events, metrics as obsm
+
+    if obsm.enabled():
+        obsm.SHARP_EDGES.inc()
+    if events.active_log() is not None:
+        events.emit_event("sharp_edge", message=msg, policy=policy.name.lower())
     if policy is SHARP_EDGES_OPTIONS.ALLOW:
         return
     full = (

@@ -389,5 +389,12 @@ class BoundSymbol(baseutils.BoundSymbolInterface):
                     lines.append("# " + sline if False else sline)
         return lines
 
+    def one_line(self) -> str:
+        """The generated line(s) of this bound symbol collapsed to one
+        string — the canonical "offending trace line" rendering shared by
+        verifier diagnostics (analysis/diagnostics.py) and instrumentation
+        attribution (observability/instrument.py)."""
+        return "; ".join(s.strip() for s in self.python(indent=0))
+
     def __repr__(self) -> str:
         return "\n".join(self.python(0, print_depth=1))

@@ -108,11 +108,32 @@ class TraceCtx:
 
     # -- codegen -------------------------------------------------------------
 
+    def pass_name(self) -> Optional[str]:
+        """The provenance pass name without its timing suffix (``"Transform
+        for execution"`` from ``"Transform for execution (took 3.2 ms)"``) —
+        the one parsing point shared by annotated codegen and
+        instrumentation attribution (observability/instrument.py)."""
+        if self.provenance is None:
+            return None
+        pss = self.provenance.pss
+        cut = pss.find(" (took")
+        return pss[:cut] if cut >= 0 else pss
+
+    def _annotate_tag(self) -> str:
+        """Compact pass-provenance tag for profiler scope names: the pass
+        name with spaces collapsed — e.g. "Transform_for_execution"."""
+        pss = self.pass_name()
+        return (pss or self.name).replace(" ", "_")
+
     def python(self, *, print_depth: int = 1, include_header: bool = True, annotate: bool = False) -> str:
         """Render the trace as Python source. ``annotate=True`` wraps each
         value-producing op in ``jax.named_scope`` so op names flow into HLO
         metadata and profiler timelines (reference: thunder/core/profile.py:15
-        `add_markers` via torch.profiler/NVTX, env THUNDER_ANNOTATE_TRACES)."""
+        `add_markers` via torch.profiler/NVTX, env THUNDER_ANNOTATE_TRACES).
+        The scope name carries the trace-line index and the pass provenance
+        (``L<idx>.<sym>@<pass>``), so a profiler row maps back to BOTH the
+        generated line and the transform that produced it
+        (docs/observability.md)."""
         lines: list[str] = []
         if include_header:
             if self.provenance is not None:
@@ -122,9 +143,11 @@ class TraceCtx:
             lines.append("")
         lines.append(self.siginfo.prettyprint())
         body: list[str] = []
-        for bsym in self.bound_symbols:
+        tag = self._annotate_tag() if annotate else ""
+        for i, bsym in enumerate(self.bound_symbols):
             if annotate and bsym.flat_proxy_outs:
-                body.append(f"{baseutils.indent(1)}with __annotate_scope({bsym.sym.name!r}):")
+                scope = f"L{i}.{bsym.sym.name}@{tag}"
+                body.append(f"{baseutils.indent(1)}with __annotate_scope({scope!r}):")
                 body.extend(bsym.python(indent=2, print_depth=print_depth))
             else:
                 body.extend(bsym.python(indent=1, print_depth=print_depth))
@@ -160,7 +183,12 @@ class TraceCtx:
     def python_callable(self, **exec_ctx) -> Callable:
         import os
 
-        annotate = os.environ.get("THUNDER_ANNOTATE_TRACES", "").lower() not in ("", "0", "false", "off")
+        def _env_flag(name: str) -> bool:
+            return os.environ.get(name, "").lower() not in ("", "0", "false", "off")
+
+        # Either spelling enables annotation; an explicitly-disabled legacy
+        # var ("0") must not shadow the new one.
+        annotate = _env_flag("THUNDER_ANNOTATE_TRACES") or _env_flag("THUNDER_TPU_ANNOTATE_TRACES")
         source = self.python(include_header=False, annotate=annotate)
         ctx = self.gen_ctx()
         if annotate:
@@ -273,12 +301,34 @@ def _maybe_verify(trc: TraceCtx) -> TraceCtx:
     return trc
 
 
+def _record_pass(pass_name: str, elapsed_ms: Optional[float], trc: TraceCtx) -> None:
+    """Observability tap on the provenance-stamping point every pass already
+    flows through: per-pass duration → metrics histogram + a "pass" event in
+    the JSONL log, correlated to the enclosing compile. Both sinks are
+    no-ops (one flag/contextvar check) when observability is off."""
+    from thunder_tpu.observability import events, metrics as obsm
+
+    if obsm.enabled() and elapsed_ms is not None:
+        obsm.PASS_MS.observe(elapsed_ms, **{"pass": pass_name})
+    if events.active_log() is not None:
+        events.emit_event(
+            "pass",
+            compile_id=events.current_compile_id(),
+            name=pass_name,
+            ms=elapsed_ms,
+            n_bsyms=len(trc.bound_symbols),
+            trace=trc.name,
+        )
+
+
 def wrap_in_trace_provenance(trc: TraceCtx, pass_name: str, start_ns: int) -> TraceCtx:
     elapsed_ms = (time.perf_counter_ns() - start_ns) / 1e6
     trc.provenance = TraceProvenance(f"{pass_name} (took {elapsed_ms:.2f} ms)")
+    _record_pass(pass_name, elapsed_ms, trc)
     return _maybe_verify(trc)
 
 
 def mark(trc: TraceCtx, pass_name: str) -> TraceCtx:
     trc.provenance = TraceProvenance(pass_name)
+    _record_pass(pass_name, None, trc)
     return _maybe_verify(trc)

@@ -166,6 +166,10 @@ def lint(fn: Callable, *args, executors: Optional[Any] = None, verbose: bool = T
             print(d.format())
         if compiled is not None:
             print(format_cache_report(compiled))
+        from thunder_tpu.observability import metrics as obsm
+
+        if obsm.enabled():
+            print(format_metrics_report())
     return diagnostics
 
 
@@ -192,6 +196,28 @@ def format_cache_report(jfn: Callable) -> str:
             f"{e['guard_fails']} guard fails, trace {e['trace_s']:.3f}s, "
             f"first run {e['first_run_s']:.3f}s"
         )
+    return "\n".join(lines)
+
+
+def format_metrics_report() -> str:
+    """One-screen summary of the process-wide observability metrics
+    (``thunder_tpu.monitor``): compiles/recompiles, cache traffic, claim
+    breakdown, padding waste — the cross-function counterpart of
+    :func:`format_cache_report`. Empty series are elided."""
+    from thunder_tpu.observability.metrics import REGISTRY
+
+    flat = REGISTRY.report_compact()
+    if not flat:
+        return "metrics: enabled, no samples yet"
+    lines = ["metrics (process-wide, thunder_tpu.monitor.report()):"]
+    for name, v in flat.items():
+        if isinstance(v, dict):  # histogram summary
+            lines.append(
+                f"  {name}: n={v['count']} mean={v['mean']:.1f} "
+                f"min={v['min']:.1f} max={v['max']:.1f}"
+            )
+        else:
+            lines.append(f"  {name}: {v}")
     return "\n".join(lines)
 
 

@@ -29,6 +29,7 @@ from jax import lax
 from thunder_tpu.core import dtypes
 from thunder_tpu.core.prims import PrimIDs
 from thunder_tpu.extend import OperatorExecutor, add_default_executor, register_executor
+from thunder_tpu.observability import metrics as obsm
 
 ex = OperatorExecutor("jax")
 register_executor(ex)
@@ -555,8 +556,15 @@ def pad_to_bucket(inps: list, sym_spec) -> list:
     """Zero-pad marked dims of the (jax) input leaves up to their bucket
     ceilings. Always returns buffers safe to donate for marked leaves: a leaf
     already at the ceiling is copied, so the caller's array is never donated
-    out from under it."""
+    out from under it.
+
+    With metrics enabled, the padded-minus-true element count per call is
+    accumulated into ``thunder_tpu_padding_waste_elements_total`` — the
+    bucket-policy tuning signal (too-coarse buckets show up as waste, not
+    just as fewer compiles)."""
     donating = _donation_active()
+    track_waste = obsm.enabled()
+    waste = 0
     out = list(inps)
     for li, dims in sym_spec.marks.items():
         x = out[li]
@@ -568,9 +576,17 @@ def pad_to_bucket(inps: list, sym_spec) -> list:
                 widths[d] = (0, delta)
                 padded = True
         if padded:
+            if track_waste:
+                true_elems = math.prod(int(s) for s in x.shape)
+                padded_elems = math.prod(
+                    int(s) + w[1] for s, w in zip(x.shape, widths)
+                )
+                waste += padded_elems - true_elems
             out[li] = jnp.pad(x, widths)
         elif donating:
             out[li] = jnp.array(x, copy=True)
+    if track_waste and waste:
+        obsm.PADDING_WASTE_ELEMENTS.inc(waste)
     return out
 
 

@@ -90,7 +90,36 @@ def transform_for_execution(trace: TraceCtx, executors_list: Sequence[Executor])
         if isinstance(ex, FusionExecutor):
             extrace = ex.fusion_pass(extrace)
 
+    extrace.tags["claim_breakdown"] = _claim_breakdown(extrace)
+    extrace.tags["collective_bytes"] = _collective_bytes(extrace)
     return wrap_in_trace_provenance(extrace, "Transform for execution", start)
+
+
+def _claim_breakdown(trace: TraceCtx) -> dict[str, int]:
+    """{executor name (or "host" for python_impl plumbing): claimed bsyms} —
+    the observability subsystem's executor-claim metric/event payload."""
+    out: dict[str, int] = {}
+    for bsym in trace.bound_symbols:
+        ex = bsym.sym.executor
+        name = ex.name if ex is not None else "host"
+        out[name] = out.get(name, 0) + 1
+    return out
+
+
+def _collective_bytes(trace: TraceCtx) -> int:
+    """Static bytes moved by collectives (COMM_OP-tagged symbols), from the
+    trace's tensor metadata: each collective is charged its tensor operands'
+    sizes. A per-trace constant — the dispatcher multiplies by call counts."""
+    from thunder_tpu.core.proxies import TensorProxy
+
+    total = 0
+    for bsym in trace.bound_symbols:
+        if OpTags.COMM_OP not in bsym.sym.tags:
+            continue
+        for p in bsym.flat_proxy_args:
+            if isinstance(p, TensorProxy):
+                total += p.size_bytes
+    return total
 
 
 def del_last_used(trace: TraceCtx, *, clear_mutable_collections: bool = False) -> TraceCtx:

@@ -486,15 +486,81 @@ class ThunderModule:
 
     # -- compilation ----------------------------------------------------------
 
+    def _event_log(self):
+        """The per-module JSONL event log (jit(events=...)), created lazily;
+        None defers to the process-wide THUNDER_TPU_EVENTS log."""
+        log = getattr(self, "_obs_event_log", None)
+        if log is None and self._jit_options.get("events"):
+            from thunder_tpu.observability.events import log_for_path
+
+            log = self._obs_event_log = log_for_path(self._jit_options["events"])
+        return log
+
     def _compile(self, args: tuple, kwargs: dict, _force_replicated_data: bool = False) -> dict:
         # Scope the trace verifier over this compile: every pass below stamps
         # provenance through wrap_in_trace_provenance/mark, which runs the
         # analysis/ rules when checks are on (jit(debug_checks=True) or
-        # THUNDER_TPU_CHECKS=1).
+        # THUNDER_TPU_CHECKS=1). The observability compile scope correlates
+        # the passes' "pass" events under one compile id and emits the
+        # compile_start/compile_end bracket (docs/observability.md).
+        import time as _time
+
         from thunder_tpu.core.trace import debug_checks
 
-        with debug_checks(self._jit_options.get("debug_checks")):
-            return self._compile_checked(args, kwargs, _force_replicated_data)
+        if getattr(self, "_in_compile", False):
+            # Re-entrant retry (_compile_checked's _force_replicated_data
+            # fallback calls back into _compile): one user-visible compile —
+            # the OUTER bracket counts and reports it; a nested bracket
+            # would double-count COMPILES and mark a first compile as a
+            # recompile.
+            with debug_checks(self._jit_options.get("debug_checks")):
+                return self._compile_checked(args, kwargs, _force_replicated_data)
+
+        from thunder_tpu.observability import events as obs_events
+        from thunder_tpu.observability import metrics as obsm
+
+        t0 = _time.perf_counter()
+        self._in_compile = True
+        try:
+            with debug_checks(self._jit_options.get("debug_checks")), \
+                    obs_events.compile_scope(self._event_log()) as compile_id:
+                # "+seq_bucket" tells the event-replay storm heuristic that
+                # one compile per sequence bucket is this function's healthy
+                # steady state (analysis/events.py).
+                cache_option = (
+                    "module+seq_bucket" if self._jit_options.get("seq_bucket")
+                    else "module"
+                )
+                obs_events.emit_event(
+                    "compile_start", compile_id=compile_id,
+                    fn=type(self._module).__name__, cache_option=cache_option,
+                    call=self._lc_cs.calls,
+                )
+                entry = self._compile_checked(args, kwargs, _force_replicated_data)
+                # Count only SUCCESSFUL builds (the functional path's
+                # semantics): a failed first compile must not make the next
+                # successful one report recompile=True.
+                self._lc_cs.compile_count += 1
+                if obsm.enabled():
+                    obsm.COMPILES.inc()
+                    if self._lc_cs.compile_count > 1:
+                        obsm.RECOMPILES.inc()
+                # Report the FORWARD execution trace (the last list entry is
+                # the backward when grad was compiled).
+                traces = entry.get("traces") or []
+                fwd_trc = None
+                if traces:
+                    fwd_trc = traces[-2] if (entry.get("bwd") is not None and len(traces) >= 2) else traces[-1]
+                obs_events.emit_compile_end(
+                    compile_id,
+                    type(self._module).__name__,
+                    (_time.perf_counter() - t0) * 1e3,
+                    fwd_trc,
+                    recompile=self._lc_cs.compile_count > 1,
+                )
+                return entry
+        finally:
+            self._in_compile = False
 
     def _compile_checked(self, args: tuple, kwargs: dict, _force_replicated_data: bool = False) -> dict:
         import jax
@@ -1010,6 +1076,7 @@ class ThunderModule:
             return [tuple(x.shape) if hasattr(x, "shape") else None for x in flat]
 
         plan = None
+        probe_failed = False
         # Fake ops never write real storage, but a module forward that
         # REPLACES a slot, lazily REGISTERS a new buffer, or caches a tensor
         # on a PLAIN attribute (e.g. `self._rope_cos = torch.cos(...)`)
@@ -1034,7 +1101,16 @@ class ThunderModule:
                         crops[i] = sp
                 plan = (len(s_padded), crops)
         except Exception:
-            plan = None  # probe unavailable → shape heuristic
+            # Probe unavailable → shape heuristic for THIS call. The failure
+            # may be transient (e.g. a lazy-init path raising under
+            # FakeTensorMode on the first call only), so caching plan=None on
+            # the FIRST failure would pin the coincidental-size heuristic
+            # forever (ADVICE r5 #4) — retry once; a second failure means the
+            # module genuinely cannot be fake-probed (data-dependent control
+            # flow) and None IS cached, so warm dispatch doesn't re-pay two
+            # fake-mode forwards per call.
+            plan = None
+            probe_failed = True
         finally:
             for d, snap in dict_snapshot:
                 for k in list(d.keys()):
@@ -1048,7 +1124,15 @@ class ThunderModule:
             for _, d, k, _v in _named_slots(self._module):
                 if (id(d), k) not in pre_keys:
                     del d[k]
-        cache[key] = plan
+        if probe_failed:
+            fails = getattr(self, "_seq_crop_probe_fails", None)
+            if fails is None:
+                fails = self._seq_crop_probe_fails = {}
+            fails[key] = fails.get(key, 0) + 1
+            if fails[key] >= 2:  # persistent: stop re-probing every call
+                cache[key] = None
+        else:
+            cache[key] = plan
         return plan
 
     def _crop_seq_outputs(self, out, t: int, t_pad: int, plan=None):
@@ -1146,13 +1230,25 @@ class ThunderModule:
                     stacklevel=3,
                 )
                 self._guard_churn_warned = True
+            from thunder_tpu.observability import events as obs_events
+            from thunder_tpu.observability import metrics as obsm
+
             cs.cache_misses += 1
+            if obsm.enabled():
+                obsm.CACHE_MISSES.inc()
+            log = self._event_log() or obs_events.active_log()
+            if log is not None:
+                log.emit("cache_miss", fn=type(self._module).__name__, call=cs.calls)
             cs.last_trace_tracing_start = timer_ns()
             entry = self._compile(args, kwargs)
             cs.last_trace_tracing_stop = timer_ns()
             self._cache.setdefault(key, []).append(entry)
         else:
             cs.cache_hits += 1
+            from thunder_tpu.observability import metrics as obsm
+
+            if obsm.enabled():
+                obsm.CACHE_HITS.inc(kind="module")
         traces = entry["traces"]
         if entry["bwd"] is not None:
             cs.last_traces = traces[:-1]

@@ -1,0 +1,60 @@
+"""``thunder_tpu.monitor`` — the operator-facing metrics facade.
+
+One import for the serving/ops story: flip metrics on, read a snapshot,
+scrape Prometheus text, or dump JSON. The heavy lifting lives in
+:mod:`thunder_tpu.observability.metrics`; this module is the stable surface
+(docs/observability.md lists every metric name).
+
+    import thunder_tpu.monitor as monitor
+
+    monitor.enable()                  # or THUNDER_TPU_METRICS=1
+    ... serve traffic ...
+    monitor.report()                  # nested dict snapshot
+    monitor.prometheus_text()         # text exposition for a /metrics endpoint
+    monitor.dump_json("metrics.json")
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from thunder_tpu.observability.metrics import (  # noqa: F401
+    REGISTRY,
+    MetricsRegistry,
+    disable,
+    enable,
+    enabled,
+)
+
+
+def report() -> dict:
+    """Full snapshot of every registered metric (histograms summarized)."""
+    return REGISTRY.report()
+
+
+def report_compact() -> dict:
+    """Flat {metric+labels: value} snapshot with empty series dropped."""
+    return REGISTRY.report_compact()
+
+
+def prometheus_text() -> str:
+    """Prometheus text exposition format (serve it from a /metrics route)."""
+    return REGISTRY.prometheus_text()
+
+
+def dump_json(path: str) -> None:
+    """Write the full snapshot (with a timestamp) as JSON to ``path``."""
+    REGISTRY.dump_json(path)
+
+
+def reset() -> None:
+    """Zero every metric (definitions stay). Tests and epoch boundaries."""
+    REGISTRY.reset()
+
+
+def set_event_log(path: Optional[str]) -> None:
+    """Point the process-wide JSONL event log at ``path`` (None disables) —
+    the programmatic spelling of ``THUNDER_TPU_EVENTS``."""
+    from thunder_tpu.observability.events import set_global_path
+
+    set_global_path(path)

@@ -1,0 +1,53 @@
+"""Runtime observability: metrics registry, event log, instrumentation,
+profiler bracketing.
+
+Four coordinated parts (ISSUE 4; reference analogues: thunder's
+CompileStats/last_traces/TraceProvenance + profile.py NVTX markers):
+
+- :mod:`~thunder_tpu.observability.metrics` — process-wide counters/gauges/
+  histograms (dispatch latency, cache hit/miss/recompile, padding waste,
+  executor-claim breakdown, collective bytes). Exported via
+  ``thunder_tpu.monitor.report()`` / JSON / Prometheus text. Enable with
+  ``THUNDER_TPU_METRICS=1`` or ``thunder_tpu.monitor.enable()``.
+- :mod:`~thunder_tpu.observability.events` — structured JSONL event log
+  (compile start/end with per-pass durations, cache, bucket, sharp-edge
+  events), gated by ``THUNDER_TPU_EVENTS=<path>`` or ``jit(events=...)``;
+  replayed by ``scripts/lint_traces.py --events``.
+- :mod:`~thunder_tpu.observability.instrument` — the per-op instrumentation
+  transform: ``jit(fn, debug_watch="nan")`` (NaN/Inf watch with BoundSymbol
+  + provenance attribution), ``instrument="time"``/``"memory"``/custom hooks.
+- :mod:`~thunder_tpu.observability.profile` — ``thunder_tpu.profile(fn,
+  *args)``: jax.profiler-bracketed steps → an xprof-ready trace dir;
+  annotated codegen stamps trace-line + pass provenance into HLO metadata.
+
+Import structure: ``metrics`` and ``events`` are stdlib-only (safe to import
+from ``core/trace.py`` and ``common.py`` without cycles); ``instrument`` and
+``profile`` import core modules and are loaded lazily here.
+"""
+
+from __future__ import annotations
+
+from thunder_tpu.observability import events, metrics  # noqa: F401
+from thunder_tpu.observability.events import EventLog, emit_event  # noqa: F401
+from thunder_tpu.observability.metrics import REGISTRY, MetricsRegistry  # noqa: F401
+
+_LAZY = {
+    "NaNWatcher": "thunder_tpu.observability.instrument",
+    "NaNWatchError": "thunder_tpu.observability.instrument",
+    "OpTimer": "thunder_tpu.observability.instrument",
+    "MemoryHighWater": "thunder_tpu.observability.instrument",
+    "InstrumentationHook": "thunder_tpu.observability.instrument",
+    "instrument_reports": "thunder_tpu.observability.instrument",
+    "profile": "thunder_tpu.observability.profile",
+}
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    val = getattr(importlib.import_module(target), name)
+    globals()[name] = val
+    return val

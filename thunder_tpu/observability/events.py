@@ -1,0 +1,208 @@
+"""Structured JSONL event log.
+
+Every durable compilation-pipeline happening — compile start/end, per-pass
+durations (from the PR 1 provenance hooks in ``core/trace.py``), cache
+misses, bucket selection, sharp-edge observations, NaN-watch trips, profile
+brackets — is one JSON object on one line, so logs stream, tail, and replay
+(``scripts/lint_traces.py --events`` / ``thunder_tpu.analysis.events``).
+
+Activation:
+
+- process-wide: ``THUNDER_TPU_EVENTS=<path>`` (checked lazily, once);
+- per-function: ``jit(fn, events="<path>")`` — that function's compiles and
+  cache events go to its own log, overriding the global one.
+
+Schema (stable; the replay tool validates it):
+
+    {"v": 1, "ts": <unix seconds>, "seq": <per-log counter>, "kind": "...",
+     ...kind-specific fields...}
+
+Kind-specific required fields live in ``thunder_tpu.analysis.events.SCHEMA``.
+Emission is a no-op costing one dict lookup when no log is active.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+from typing import Any, Optional
+
+SCHEMA_VERSION = 1
+
+
+class EventLog:
+    """Append-only JSONL sink. Opens lazily, one line per event, flushed per
+    write (a crashed process keeps everything emitted before the crash).
+
+    Construct via :func:`log_for_path` — one shared instance per path, so
+    two functions logging to the same file share one handle and one ``seq``
+    counter (independent instances would interleave duplicate seq values)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._dead = False
+
+    def emit(self, kind: str, **fields) -> None:
+        # Observability must never take the workload down: a sink I/O
+        # failure (unwritable path, disk full) warns once and disables this
+        # log instead of crashing the compile/training step it observes.
+        if self._dead:
+            return
+        rec = {"v": SCHEMA_VERSION, "ts": time.time(), "kind": kind}
+        rec.update(fields)
+        try:
+            with self._lock:
+                if self._f is None:
+                    d = os.path.dirname(os.path.abspath(self.path))
+                    if d:
+                        os.makedirs(d, exist_ok=True)
+                    self._f = open(self.path, "a")
+                rec["seq"] = self._seq
+                self._f.write(json.dumps(rec, default=str))
+                self._f.write("\n")
+                self._f.flush()
+                self._seq += 1
+        except OSError as e:
+            self._dead = True
+            import warnings
+
+            warnings.warn(
+                f"thunder_tpu event log {self.path!r} disabled after I/O "
+                f"failure: {e}",
+                stacklevel=3,
+            )
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+# -- active-log resolution ----------------------------------------------------
+
+_active_log: contextvars.ContextVar[Optional[EventLog]] = contextvars.ContextVar(
+    "thunder_tpu_event_log", default=None
+)
+_global = {"path": None, "log": None}
+_logs_by_path: dict[str, EventLog] = {}
+
+
+def log_for_path(path: str) -> EventLog:
+    """The shared :class:`EventLog` for ``path`` (one instance per absolute
+    path process-wide — keeps the per-log ``seq`` counter monotonic when
+    several functions log to the same file)."""
+    key = os.path.abspath(path)
+    log = _logs_by_path.get(key)
+    if log is None:
+        log = _logs_by_path[key] = EventLog(path)
+    return log
+
+
+def set_global_path(path: Optional[str]) -> None:
+    """Point the process-wide log somewhere (None disables). Mostly for
+    tests; production uses THUNDER_TPU_EVENTS."""
+    _global["path"] = path
+    _global["log"] = log_for_path(path) if path else None
+    _global["resolved"] = True
+
+
+def _global_log() -> Optional[EventLog]:
+    if not _global.get("resolved"):
+        path = os.environ.get("THUNDER_TPU_EVENTS", "").strip()
+        _global["path"] = path or None
+        _global["log"] = log_for_path(path) if path else None
+        _global["resolved"] = True
+    return _global["log"]
+
+
+def active_log() -> Optional[EventLog]:
+    log = _active_log.get()
+    if log is not None:
+        return log
+    return _global_log()
+
+
+def emit_event(kind: str, **fields) -> None:
+    """Emit to the active log (contextvar override, else the global
+    THUNDER_TPU_EVENTS log); no-op when neither is configured."""
+    log = active_log()
+    if log is not None:
+        log.emit(kind, **fields)
+
+
+def emit_compile_end(
+    compile_id, fn_name: str, ms: float, trace=None, *,
+    symbolic: bool = False, recompile: bool = False, staged: bool = True,
+) -> None:
+    """The one writer of ``compile_end`` records, shared by the functional
+    pipeline (api._compile_entry_checked) and the module frontend
+    (frontend/module.py) so the schema cannot diverge between producers.
+    ``trace`` is the final execution trace; its ``claim_breakdown`` /
+    ``collective_bytes`` tags (stamped by executors/passes.py) become the
+    event's executor and collective payloads."""
+    log = active_log()
+    if log is None:
+        return
+    tags = getattr(trace, "tags", None) or {}
+    log.emit(
+        "compile_end",
+        compile_id=compile_id,
+        fn=fn_name,
+        ms=ms,
+        n_bsyms=len(trace.bound_symbols) if trace is not None else None,
+        claims=tags.get("claim_breakdown") or {},
+        collective_bytes=int(tags.get("collective_bytes") or 0),
+        symbolic=symbolic,
+        recompile=recompile,
+        staged=staged,
+    )
+
+
+@contextlib.contextmanager
+def event_scope(log: Optional[EventLog]):
+    """Route ``emit_event`` to ``log`` within the scope (None = no change)."""
+    if log is None:
+        yield
+        return
+    tok = _active_log.set(log)
+    try:
+        yield
+    finally:
+        _active_log.reset(tok)
+
+
+# -- compile correlation ------------------------------------------------------
+# Per-pass events fire deep inside core/trace.py with no compile handle in
+# scope; a contextvar carries the compile id so one compile's pass events
+# correlate in the log.
+
+_compile_id: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
+    "thunder_tpu_compile_id", default=None
+)
+_compile_seq = {"n": 0}
+
+
+def current_compile_id() -> Optional[int]:
+    return _compile_id.get()
+
+
+@contextlib.contextmanager
+def compile_scope(log: Optional[EventLog] = None):
+    """Allocate a process-unique compile id, route events to ``log`` (when
+    given), and yield the id. Used by ``api._compile_entry``."""
+    _compile_seq["n"] += 1
+    cid = _compile_seq["n"]
+    tok = _compile_id.set(cid)
+    try:
+        with event_scope(log):
+            yield cid
+    finally:
+        _compile_id.reset(tok)

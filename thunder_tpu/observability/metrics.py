@@ -1,0 +1,328 @@
+"""Low-overhead process-wide metrics registry.
+
+The runtime half of the observability subsystem (reference analogue:
+thunder's ``CompileStats`` timers, generalized): counters, gauges, and
+histograms that the dispatch/compile paths update and
+``thunder_tpu.monitor.report()`` exports — as a nested dict, a JSON dump, or
+Prometheus text exposition format.
+
+Design constraints (the reason this is not a prometheus_client dependency):
+
+- **Disabled must be free.** Every mutate method checks one module-level
+  flag and returns; the GPT-block dispatch bench budget is <1% overhead with
+  observability off and <5% with metrics on (BENCHMARKS.md).
+- **No locks on the hot path.** CPython dict ops are atomic enough for
+  monotonic counters; a torn read in ``report()`` costs one sample, never a
+  crash. (Compile-side metrics are effectively single-threaded anyway.)
+- **Process-wide, not per-function.** Per-function counters live on
+  ``CompileStats`` (``thunder_tpu.cache_info``); this registry aggregates
+  across every compiled function so one scrape describes the whole server.
+
+Enable with ``THUNDER_TPU_METRICS=1`` or :func:`enable` (the
+``thunder_tpu.monitor`` facade re-exports both spellings).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from bisect import bisect_left
+from typing import Any, Optional
+
+
+_state = {
+    "enabled": os.environ.get("THUNDER_TPU_METRICS", "").strip().lower()
+    not in ("", "0", "false", "off")
+}
+
+
+def enable() -> None:
+    _state["enabled"] = True
+
+
+def disable() -> None:
+    _state["enabled"] = False
+
+
+def enabled() -> bool:
+    return _state["enabled"]
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items())) if labels else ()
+
+
+def _label_str(key: tuple) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+
+
+class _Metric:
+    kind = "untyped"
+    __slots__ = ("name", "help", "_values")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._values: dict[tuple, Any] = {}
+
+    def clear(self) -> None:
+        self._values.clear()
+
+    def series(self) -> dict[tuple, Any]:
+        return dict(self._values)
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (optionally labelled)."""
+
+    kind = "counter"
+    __slots__ = ()
+
+    def inc(self, n: float = 1, **labels) -> None:
+        if not _state["enabled"]:
+            return
+        k = tuple(sorted(labels.items())) if labels else ()
+        self._values[k] = self._values.get(k, 0) + n
+
+    def value(self, **labels) -> float:
+        return self._values.get(_label_key(labels), 0)
+
+
+class Gauge(_Metric):
+    """Last-written value (optionally labelled); ``set_max`` keeps the peak."""
+
+    kind = "gauge"
+    __slots__ = ()
+
+    def set(self, v: float, **labels) -> None:
+        if not _state["enabled"]:
+            return
+        self._values[_label_key(labels)] = v
+
+    def set_max(self, v: float, **labels) -> None:
+        if not _state["enabled"]:
+            return
+        k = _label_key(labels)
+        cur = self._values.get(k)
+        if cur is None or v > cur:
+            self._values[k] = v
+
+    def value(self, **labels) -> Optional[float]:
+        return self._values.get(_label_key(labels))
+
+
+# Log-spaced default buckets: cover 1us..100s when observing microseconds.
+_DEFAULT_BUCKETS = (1.0, 10.0, 100.0, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8)
+
+
+class Histogram(_Metric):
+    """count/sum/min/max plus log-spaced bucket counts.
+
+    Hot-path discipline: ``observe`` stores RAW per-bucket counts via one
+    bisect (the last slot is the +Inf overflow); the Prometheus-style
+    cumulative counts are derived at render time (``summary``/
+    ``prometheus_text``), keeping the per-observation cost flat."""
+
+    kind = "histogram"
+    __slots__ = ("buckets",)
+
+    def __init__(self, name: str, help: str = "", buckets: tuple = _DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        self.buckets = tuple(buckets)
+
+    def observe(self, v: float, **labels) -> None:
+        if not _state["enabled"]:
+            return
+        k = tuple(sorted(labels.items())) if labels else ()
+        s = self._values.get(k)
+        if s is None:
+            s = self._values[k] = {
+                "count": 0, "sum": 0.0, "min": v, "max": v,
+                "raw_buckets": [0] * (len(self.buckets) + 1),
+            }
+            s["count"] = 1
+            s["sum"] = v
+            s["raw_buckets"][bisect_left(self.buckets, v)] = 1
+            return
+        s["count"] += 1
+        s["sum"] += v
+        if v < s["min"]:
+            s["min"] = v
+        elif v > s["max"]:
+            s["max"] = v
+        s["raw_buckets"][bisect_left(self.buckets, v)] += 1
+
+    def _cumulative(self, raw: list) -> list:
+        out = []
+        acc = 0
+        for c in raw[:-1]:  # last slot is the +Inf overflow
+            acc += c
+            out.append(acc)
+        return out
+
+    def summary(self, **labels) -> Optional[dict]:
+        s = self._values.get(_label_key(labels))
+        if s is None:
+            return None
+        out = {k: s[k] for k in ("count", "sum", "min", "max")}
+        out["bucket_counts"] = self._cumulative(s["raw_buckets"])
+        out["mean"] = s["sum"] / s["count"] if s["count"] else 0.0
+        return out
+
+
+class MetricsRegistry:
+    """Name → metric, get-or-create. One process-wide instance (``REGISTRY``)
+    plus constructible for tests."""
+
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str, **kw) -> _Metric:
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls(name, help, **kw)
+        elif not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {m.kind}, not {cls.kind}"
+            )
+        return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "", buckets: tuple = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def reset(self) -> None:
+        """Clear every metric's values (definitions stay registered)."""
+        for m in self._metrics.values():
+            m.clear()
+
+    # -- export ---------------------------------------------------------------
+
+    def report(self) -> dict:
+        """Nested snapshot: name -> {kind, help, values: {label_str: value}}.
+        Histogram values are the count/sum/min/max/mean summaries."""
+        out: dict[str, Any] = {}
+        for name, m in sorted(self._metrics.items()):
+            values: dict[str, Any] = {}
+            for k in list(m._values):
+                if isinstance(m, Histogram):
+                    values[_label_str(k)] = m.summary(**dict(k))
+                else:
+                    values[_label_str(k)] = m._values.get(k)
+            out[name] = {"kind": m.kind, "help": m.help, "values": values}
+        return out
+
+    def report_compact(self) -> dict:
+        """Flat {name+labels: value} snapshot with empty series dropped —
+        what ``bench.py`` embeds in its JSON line."""
+        out: dict[str, Any] = {}
+        for name, m in sorted(self._metrics.items()):
+            for k in list(m._values):
+                if isinstance(m, Histogram):
+                    s = m.summary(**dict(k))
+                    if s:
+                        out[f"{name}{_label_str(k)}"] = {
+                            kk: s[kk] for kk in ("count", "sum", "mean", "min", "max")
+                        }
+                else:
+                    out[f"{name}{_label_str(k)}"] = m._values.get(k)
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (histograms as _bucket/_sum/_count)."""
+        lines: list[str] = []
+        for name, m in sorted(self._metrics.items()):
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            for k in list(m._values):
+                if isinstance(m, Histogram):
+                    s = m._values.get(k)
+                    if s is None:
+                        continue
+                    base = dict(k)
+                    for le, c in zip(m.buckets, m._cumulative(s["raw_buckets"])):
+                        lk = _label_str(_label_key(dict(base, le=repr(le))))
+                        lines.append(f"{name}_bucket{lk} {c}")
+                    lk = _label_str(_label_key(dict(base, le="+Inf")))
+                    lines.append(f"{name}_bucket{lk} {s['count']}")
+                    lines.append(f"{name}_sum{_label_str(k)} {s['sum']}")
+                    lines.append(f"{name}_count{_label_str(k)} {s['count']}")
+                else:
+                    lines.append(f"{name}{_label_str(k)} {m._values.get(k)}")
+        return "\n".join(lines) + "\n"
+
+    def dump_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"ts": time.time(), "metrics": self.report()}, f, indent=2, default=str)
+            f.write("\n")
+
+
+REGISTRY = MetricsRegistry()
+
+# -- the framework's own metrics ----------------------------------------------
+# Registered eagerly so report()/prometheus_text() list them (with empty
+# series) even before traffic, and so hot paths share these handles instead
+# of doing name lookups.
+
+DISPATCH_US = REGISTRY.histogram(
+    "thunder_tpu_dispatch_us",
+    "Host-side dispatch wall time per compiled-function call (us), cache lookup through result",
+)
+CACHE_LOOKUP_US = REGISTRY.histogram(
+    "thunder_tpu_cache_lookup_us", "Cache lookup (guard evaluation) time per call (us)"
+)
+CACHE_HITS = REGISTRY.counter(
+    "thunder_tpu_cache_hits_total",
+    "Cache hits across all compiled functions, labelled kind=fast|slow|same_input|module",
+)
+CACHE_MISSES = REGISTRY.counter(
+    "thunder_tpu_cache_misses_total", "Cache misses (each triggers a compile)"
+)
+COMPILES = REGISTRY.counter(
+    "thunder_tpu_compiles_total", "Trace compilations (acquisition through staging)"
+)
+RECOMPILES = REGISTRY.counter(
+    "thunder_tpu_recompiles_total", "Compilations beyond a function's first — the storm signal"
+)
+COMPILE_MS = REGISTRY.histogram(
+    "thunder_tpu_compile_ms", "End-to-end compile time per entry (ms)"
+)
+PASS_MS = REGISTRY.histogram(
+    "thunder_tpu_pass_ms", "Per-transform-pass duration (ms), labelled by pass"
+)
+CLAIMED_BSYMS = REGISTRY.counter(
+    "thunder_tpu_claimed_bsyms_total", "Executor-claim breakdown of execution traces, labelled by executor"
+)
+COLLECTIVE_BYTES = REGISTRY.counter(
+    "thunder_tpu_collective_bytes_traced_total",
+    "Bytes moved by collectives per traced program (static, from trace metadata)",
+)
+PADDING_WASTE_ELEMENTS = REGISTRY.counter(
+    "thunder_tpu_padding_waste_elements_total",
+    "Elements of bucket padding dispatched (padded minus true extents)",
+)
+BUCKET_COMPILES = REGISTRY.counter(
+    "thunder_tpu_bucket_compiles_total", "Symbolic-values compiles, one per shape bucket"
+)
+SHARP_EDGES = REGISTRY.counter(
+    "thunder_tpu_sharp_edges_total", "Sharp-edge observations during tracing"
+)
+NAN_WATCH_TRIPS = REGISTRY.counter(
+    "thunder_tpu_nan_watch_trips_total", "NaN/Inf watch detections, labelled by symbol"
+)
+INSTRUMENTED_OP_US = REGISTRY.histogram(
+    "thunder_tpu_instrumented_op_us", "Per-op wall time under the OpTimer hook (us), labelled by symbol"
+)
+DEVICE_MEM_HIGH_WATER = REGISTRY.gauge(
+    "thunder_tpu_device_mem_high_water_bytes",
+    "Peak device memory observed by the MemoryHighWater hook",
+)

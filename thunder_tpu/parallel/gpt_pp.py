@@ -113,10 +113,15 @@ def build_gpt_pp_fns(config: GPTConfig, n_stages: int, mb: int, T: int,
     from thunder_tpu.models import gpt as m
 
     per = config.n_layer // n_stages
-    jdt = _dt.to_jax_dtype(dtype or _dt.bfloat16)
+    # Normalize any dtype-like (framework dtype, jax/np dtype) so callers can
+    # forward the live params' dtype directly (ADVICE r5 #1): the staging
+    # examples must match the real values or the trunk bakes wrong-precision
+    # rope constants and executors claim on wrong dtype metadata.
+    fdt = _dt.to_dtype(dtype, true_dtype=True) if dtype is not None else _dt.bfloat16
+    jdt = _dt.to_jax_dtype(fdt)
 
     ex_idx = np.zeros((mb, T), np.int32)
-    ex_params = m.init_params(config, dtype=_dt.to_dtype(dtype or _dt.bfloat16), seed=0)
+    ex_params = m.init_params(config, dtype=fdt, seed=0)
     ex_x = np.zeros((mb, T, config.n_embd), jdt)
     ex_blocks = ex_params["blocks"][:per]
 
@@ -179,8 +184,11 @@ def gpt_pp_loss_and_grads(config: GPTConfig, params: dict, idx, tgt, mesh,
     n_stages = mesh.shape["pp"]
     B, T = idx.shape
     mb = B // n_micro
+    # Stage on the LIVE params' dtype: an f32 model staged on the bf16
+    # default would bake bf16 rope cos/sin constants inside an f32 trunk.
+    params_dtype = jax.tree_util.tree_leaves(params)[0].dtype
     first_fn, stage_fn, last_fn = build_gpt_pp_fns(
-        config, n_stages, mb, T, executors=executors
+        config, n_stages, mb, T, executors=executors, dtype=params_dtype
     )
     stacked = split_params_for_pp(params, n_stages)
     streams = {

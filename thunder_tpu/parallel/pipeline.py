@@ -160,7 +160,7 @@ def pipeline_1f1b(
 
     Schedule (classic non-interleaved 1F1B, expressed as a uniform SPMD
     tick): stage ``s`` runs forward for microbatch ``f`` at tick
-    ``s + f`` and backward for microbatch ``b`` at tick
+    ``s + 2·f`` and backward for microbatch ``b`` at tick
     ``2·n_stages − 2 − s + 2·b + 1`` — between warmup and drain each stage
     alternates one-forward/one-backward. Total ``2·(n_micro + n_stages − 1)``
     ticks. Forward activations hop down the ring on even phases, cotangents
