@@ -203,7 +203,83 @@ def _bench_forward():
     print(f"# fwd param-init: {init_s:.1f}s trace+claim: {trace_s:.1f}s compile: {compile_s:.1f}s "
           f"avg of 5 batched-dispatch runs: {avg:.4f}s",
           file=sys.stderr)
-    return avg, trace_s, compile_s
+    return avg, trace_s, compile_s, jfn, flat_args
+
+
+def _bench_attribution(jfn, flat_args, steps: int = 2):
+    """Top-5 per-op device-time attribution of the forward (ISSUE 5): two
+    profiler-bracketed dispatches, HLO scopes mapped back to trace lines.
+    Returns {"coverage_pct", "top5": [...]} or None when the backend has no
+    profiler plugin / the trace carries no scopes — never fails the bench."""
+    import tempfile
+
+    try:
+        import thunder_tpu as ttpu
+        from thunder_tpu.observability.attribution import attribute
+
+        hlo_text = None
+        try:
+            if hasattr(jfn, "as_text"):
+                hlo_text = jfn.as_text()
+        except Exception:
+            hlo_text = None
+        trace_dir = tempfile.mkdtemp(prefix="thunder_bench_attr_")
+        res = ttpu.profile(lambda: jfn(*flat_args), trace_dir=trace_dir,
+                           steps=steps, warmup=0)
+        if not res["profiler"]:
+            print("# attribution skipped: no profiler plugin on this backend", file=sys.stderr)
+            return None
+        # profile() already attributed in-process when the event names carry
+        # scopes (TPU); re-parse only for raw-op-name backends needing the
+        # HLO join.
+        attr = res["attribution"]
+        if attr is None:
+            attr = attribute(trace_dir, hlo_text=hlo_text)
+        if not attr.by_line:
+            print("# attribution skipped: no L<idx>.<sym> scopes in the profile "
+                  "(THUNDER_TPU_ANNOTATE_TRACES not active at codegen?)", file=sys.stderr)
+            return None
+        top5 = [
+            {
+                "line": ref.label,
+                "sym": ref.sym,
+                "pass": ref.pass_name,
+                "us_per_step": round(us / steps, 1),
+                "share_pct": round(us / attr.device_busy_us * 100.0, 1),
+            }
+            for ref, us in attr.top(5)
+        ]
+        print("# fwd attribution (top 5 of "
+              f"{attr.device_busy_us / steps / 1e3:.1f} ms device-busy/step, "
+              f"{attr.coverage * 100:.0f}% attributed):", file=sys.stderr)
+        for row in top5:
+            print(f"#   {row['line']:<40} {row['us_per_step']:>9}us {row['share_pct']:>5}%",
+                  file=sys.stderr)
+        return {"coverage_pct": round(attr.coverage * 100.0, 1), "top5": top5}
+    except Exception as e:
+        print(f"# attribution skipped ({type(e).__name__}: {e})", file=sys.stderr)
+        return None
+
+
+def _load_prev_round():
+    """(label, metrics) of the newest committed BENCH_r*.json next to this
+    script, or (None, None) — bench.py prints per-metric deltas against it so
+    a regression is visible at the moment it happens, not five rounds later."""
+    import glob
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    if not paths:
+        return None, None
+    sys.path.insert(0, os.path.join(here, "scripts"))
+    try:
+        from perf_report import load_round
+
+        return load_round(paths[-1])
+    except Exception as e:
+        print(f"# prev-round load failed ({type(e).__name__}: {e})", file=sys.stderr)
+        return None, None
 
 
 def _bench_train():
@@ -396,8 +472,16 @@ def _tpu_peak_tflops() -> float:
 
 
 def main() -> None:
+    import os
+
     import thunder_tpu.monitor as monitor
     from thunder_tpu.api import _ensure_runtime
+    from thunder_tpu.observability import metrics as obsm
+
+    # Annotated codegen is free at steady state (named_scope only shapes HLO
+    # metadata during jit tracing) and is what lets the profiler rows map
+    # back to trace lines for the attribution table below.
+    os.environ.setdefault("THUNDER_TPU_ANNOTATE_TRACES", "1")
 
     _ensure_runtime()  # torch-faithful dtypes + persistent XLA compile cache
     obs_dispatch_us, obs_disabled_pct, obs_metrics_pct = _bench_obs_overhead()
@@ -405,9 +489,14 @@ def main() -> None:
     # populated observability snapshot (ISSUE 4: BENCH_*.json embeds it).
     monitor.enable()
     recompile_count, lookup_us = _bench_cache()
-    fwd_avg, fwd_trace_s, fwd_compile_s = _bench_forward()
+    fwd_avg, fwd_trace_s, fwd_compile_s, fwd_jfn, fwd_args = _bench_forward()
+    attribution = _bench_attribution(fwd_jfn, fwd_args)
     (train_avg, train_synced, train_strict, train_total,
      train_trace_s, train_compile_s) = _bench_train()
+    # The end-to-end XLA compile totals as labelled histogram samples — the
+    # metric whose 2x jump (r4->r5) per-pass ms could not see (ISSUE 5).
+    obsm.XLA_COMPILE_S.observe(fwd_compile_s, cls="bench_forward")
+    obsm.XLA_COMPILE_S.observe(train_compile_s, cls="bench_train_step")
 
     peak = _tpu_peak_tflops()
     fwd_flops = 2.0 * N_PARAMS * FWD_B * FWD_T
@@ -419,7 +508,7 @@ def main() -> None:
     # (312 bf16 TFLOP/s peak) from the same FLOP model.
     ref_train_mfu = train_flops / REF_TRAIN_ITER_A100_S / 1e12 / 312.0
 
-    print(json.dumps({
+    result = {
         "metric": "open_llama_3b_train_iter_b2_t2048",
         "value": round(train_avg, 4),
         "unit": "s",
@@ -460,8 +549,36 @@ def main() -> None:
         "obs_gpt_block_dispatch_us": round(obs_dispatch_us, 1),
         "obs_disabled_overhead_pct": round(obs_disabled_pct, 4),
         "obs_metrics_overhead_pct": round(obs_metrics_pct, 4),
+        # Top-5 device-time attribution of the forward (None when the
+        # backend has no profiler plugin): which trace lines eat the step.
+        "attribution": attribution,
         "metrics": monitor.report_compact(),
-    }))
+    }
+
+    # Deltas vs the newest committed round (ISSUE 5): a >10% regression on
+    # any gated metric warns HERE, in the run that introduced it — the
+    # committed-history gate (scripts/perf_report.py --history) is the
+    # backstop, not the first line of defense.
+    prev_label, prev_metrics = _load_prev_round()
+    if prev_metrics:
+        try:
+            from perf_report import compare_rounds
+
+            cur_cmp = dict(result)
+            cur_cmp["_metric_name"] = result["metric"]
+            deltas, regressions = compare_rounds(prev_metrics, cur_cmp, threshold=0.10)
+            result["prev_round"] = prev_label
+            result["deltas_vs_prev"] = deltas
+            result["regressions_vs_prev"] = regressions
+            shown = {k: v for k, v in sorted(deltas.items(), key=lambda kv: -abs(kv[1]))[:8]}
+            print(f"# deltas vs {prev_label}: " + ", ".join(
+                f"{k} {v * 100:+.1f}%" for k, v in shown.items()), file=sys.stderr)
+            for r in regressions:
+                print(f"# WARNING: regression vs {prev_label}: {r}", file=sys.stderr)
+        except Exception as e:
+            print(f"# delta computation failed ({type(e).__name__}: {e})", file=sys.stderr)
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

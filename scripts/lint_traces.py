@@ -10,13 +10,20 @@ autocast, RNG functionalization) is verified at the point it runs.
 
 Exit status is non-zero if any ERROR-severity diagnostic is found.
 
+The full run also executes the bench regression gate
+(``scripts/perf_report.py --history --gate``) over the committed
+``BENCH_r*.json`` rounds, so a future bench regression beyond threshold
+fails this script loudly (known regressions are acknowledged in
+``BENCH_ACK.json``).
+
 Usage:
-    python scripts/lint_traces.py            # all programs
+    python scripts/lint_traces.py            # all programs + bench gate
     python scripts/lint_traces.py gpt        # substring-filter by name
-    python scripts/lint_traces.py --events LOG.jsonl
-        # replay an observability event log (THUNDER_TPU_EVENTS /
+    python scripts/lint_traces.py --events LOG.jsonl [LOG2.jsonl ...]
+        # replay observability event log(s) (THUNDER_TPU_EVENTS /
         # jit(events=...)): validates the JSONL schema and flags recompile
-        # storms (thunder_tpu.analysis.events; docs/observability.md)
+        # storms; several per-host logs are merged with stable ordering
+        # (thunder_tpu.analysis.events; docs/observability.md)
 """
 
 from __future__ import annotations
@@ -90,11 +97,14 @@ def _grad_workloads():
     ]
 
 
-def _replay(path: str, storm_threshold: int) -> int:
+def _replay(paths: list, storm_threshold: int) -> int:
     from thunder_tpu.analysis import Severity
     from thunder_tpu.analysis.events import format_replay, replay_events
 
-    summary, diags = replay_events(path, storm_threshold=storm_threshold)
+    # One path keeps single-log semantics (per-line diagnostics); several are
+    # merged with stable (ts, host, pid, seq) ordering before replay.
+    source = paths[0] if len(paths) == 1 else paths
+    summary, diags = replay_events(source, storm_threshold=storm_threshold)
     print(format_replay(summary, diags))
     n_errors = sum(1 for d in diags if d.severity >= Severity.ERROR)
     print(f"\nlint_traces --events: {n_errors} error(s), "
@@ -102,7 +112,26 @@ def _replay(path: str, storm_threshold: int) -> int:
     return 1 if n_errors else 0
 
 
-_USAGE = "usage: lint_traces.py [pattern] | --events <log.jsonl> [--storm-threshold N]"
+def _bench_history_gate() -> int:
+    """Run the bench regression gate over the committed BENCH_r*.json
+    history (scripts/perf_report.py). Returns the number of errors (0 when
+    fewer than two committed rounds exist)."""
+    import glob
+
+    scripts_dir = os.path.dirname(os.path.abspath(__file__))
+    repo_root = os.path.dirname(scripts_dir)
+    paths = sorted(glob.glob(os.path.join(repo_root, "BENCH_r*.json")))
+    if len(paths) < 2:
+        return 0
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    from perf_report import run_history_gate
+
+    print("--- bench regression gate (perf_report --history --gate)")
+    return run_history_gate(paths, gate=True)
+
+
+_USAGE = "usage: lint_traces.py [pattern] | --events <log.jsonl> [...] [--storm-threshold N]"
 
 
 def main(argv=None) -> int:
@@ -110,7 +139,11 @@ def main(argv=None) -> int:
 
     if "--events" in argv:
         i = argv.index("--events")
-        path = argv[i + 1] if i + 1 < len(argv) and not argv[i + 1].startswith("--") else None
+        paths = []
+        for a in argv[i + 1:]:
+            if a.startswith("--"):
+                break
+            paths.append(a)
         storm = 4
         if "--storm-threshold" in argv:
             j = argv.index("--storm-threshold")
@@ -119,13 +152,13 @@ def main(argv=None) -> int:
             except (IndexError, ValueError):
                 print(_USAGE, file=sys.stderr)
                 return 2
-        if path is None:
+        if not paths:
             print(_USAGE, file=sys.stderr)
             return 2
         try:
-            return _replay(path, storm)
+            return _replay(paths, storm)
         except OSError as e:
-            print(f"lint_traces --events: cannot read {path!r}: {e}", file=sys.stderr)
+            print(f"lint_traces --events: cannot read {paths}: {e}", file=sys.stderr)
             return 2
 
     pattern = argv[0] if argv else ""
@@ -160,6 +193,11 @@ def main(argv=None) -> int:
         except TraceVerificationError as e:
             n_errors += 1
             print(f"    FAILED: {e}")
+
+    # CI half of the perf observatory (ISSUE 5): a committed bench round
+    # regressing beyond threshold fails the lint run, not just a human's eye.
+    if not pattern:
+        n_errors += _bench_history_gate()
 
     print(f"\nlint_traces: {n_errors} error(s), {n_warnings} warning(s)")
     return 1 if n_errors else 0

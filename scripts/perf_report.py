@@ -1,0 +1,366 @@
+#!/usr/bin/env python
+"""Performance attribution reports and the bench regression gate.
+
+Two modes:
+
+**History / regression gate** — build the perf trajectory across committed
+bench rounds and flag per-metric deltas beyond thresholds::
+
+    python scripts/perf_report.py --history BENCH_r0*.json
+    python scripts/perf_report.py --history BENCH_r0*.json --gate   # CI: exit 1
+                                                                    # on un-acked regressions
+
+Metric direction is inferred from the name (times/counts: lower is better;
+MFU/throughput/ratios-vs-baseline: higher is better); sub-noise-floor
+deltas on second-scale trace/compile timings are ignored. Known, accepted
+regressions live in ``BENCH_ACK.json`` at the repo root (``--ack`` to point
+elsewhere) so the gate stays green on history while failing loudly on new
+regressions — the committed file acknowledges the r4→r5
+``train_xla_compile_s`` 20.7s→43.3s jump this tool was built to catch.
+``scripts/lint_traces.py`` runs the gate over the committed history.
+
+**Attribution** — the measured/roofline report over a profile directory
+(``thunder_tpu.profile()`` run under ``THUNDER_TPU_ANNOTATE_TRACES=1``)::
+
+    python scripts/perf_report.py --trace-dir /tmp/prof --steps 3
+    python scripts/perf_report.py --trace-dir /tmp/prof --model gpt-tiny \
+        --batch 2 --seq 16        # join the static cost model → roofline/MFU
+
+See docs/performance.md for the full profile → perf_report → roofline
+workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Any, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# =============================================================================
+# History / regression gate
+# =============================================================================
+
+# Direction inference: higher-is-better substrings win first (an MFU ratio
+# name like train_synced_mfu_vs_ref_mfu must not fall through to the "_s"
+# time suffix), then lower-is-better time/count shapes. Unmatched metrics are
+# reported in the trajectory but never gated.
+_HIGHER_SUBSTRINGS = ("mfu", "vs_baseline", "tokens_per_sec", "dots_passed")
+_LOWER_SUFFIXES = ("_s", "_us", "_ms", "_pct", "_seconds")
+_LOWER_EXACT = {"value", "recompile_count"}
+
+# Absolute-delta floors (same units as the metric): second-scale pipeline
+# timings jitter ±0.3s run to run; a 0.2s→0.3s "+50%" is noise, a
+# 20.7s→43.3s "+109%" is not.
+_NOISE_FLOORS = (
+    ("trace_claim_s", 1.0),
+    ("xla_compile_s", 2.0),
+    ("lookup_us", 5.0),
+    ("dispatch_us", 20.0),
+    ("overhead_pct", 0.5),
+)
+
+
+def metric_direction(name: str) -> Optional[int]:
+    """+1 = higher is better, -1 = lower is better, None = not gated."""
+    low = name.lower()
+    if any(s in low for s in _HIGHER_SUBSTRINGS):
+        return 1
+    if low in _LOWER_EXACT or low.endswith(_LOWER_SUFFIXES):
+        return -1
+    return None
+
+
+def noise_floor(name: str) -> float:
+    low = name.lower()
+    for suffix, floor in _NOISE_FLOORS:
+        if low.endswith(suffix):
+            return floor
+    return 0.0
+
+
+# Headline fields whose meaning follows the round's "metric" name (r01's
+# headline was the forward bench, r02+ the training bench): only comparable
+# when consecutive rounds benched the same thing.
+_HEADLINE_KEYS = {"value", "vs_baseline", "tokens_per_sec", "mfu", "baseline_mfu_a100"}
+
+
+def load_round(path: str) -> tuple[str, dict[str, float]]:
+    """(round label, numeric metrics) from one committed bench JSON — the
+    driver's ``{"n", "cmd", "rc", "tail", "parsed": {...}}`` wrapper or a
+    bare ``bench.py`` JSON line. The round's headline ``metric`` name is kept
+    under ``_metric_name`` for the comparability check."""
+    with open(path) as f:
+        doc = json.load(f)
+    metrics = doc.get("parsed", doc) if isinstance(doc, dict) else {}
+    if not isinstance(metrics, dict):
+        metrics = {}
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    label = f"r{int(m.group(1)):02d}" if m else os.path.basename(path)
+    out = {
+        k: float(v)
+        for k, v in metrics.items()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+    }
+    if isinstance(metrics.get("metric"), str):
+        out["_metric_name"] = metrics["metric"]  # type: ignore[assignment]
+    return label, out
+
+
+@dataclass
+class Regression:
+    metric: str
+    frm: str
+    to: str
+    prev: float
+    cur: float
+    pct: float  # signed relative change
+    acked: bool = False
+    reason: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.frm}->{self.to}:{self.metric}"
+
+    def format(self) -> str:
+        tag = "acked" if self.acked else "REGRESSION"
+        note = f" ({self.reason})" if self.reason else ""
+        return (
+            f"{tag}: {self.metric} {self.prev:g} -> {self.cur:g} "
+            f"({self.pct * 100:+.1f}%) over {self.frm}->{self.to}{note}"
+        )
+
+
+def load_ack(path: Optional[str]) -> dict[str, str]:
+    """``{transition:metric -> reason}`` from a BENCH_ACK.json file."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        doc = json.load(f)
+    out: dict[str, str] = {}
+    for entry in doc.get("acknowledged", []):
+        out[f"{entry['transition']}:{entry['metric']}"] = entry.get("reason", "")
+    return out
+
+
+def analyze_history(
+    rounds: list[tuple[str, dict[str, float]]],
+    *,
+    threshold: float = 0.10,
+    ack: Optional[dict[str, str]] = None,
+) -> list[Regression]:
+    """Regressions across every consecutive round pair: a gated metric whose
+    relative change exceeds ``threshold`` in the bad direction AND whose
+    absolute delta clears the metric's noise floor."""
+    ack = ack or {}
+    out: list[Regression] = []
+    for (l0, m0), (l1, m1) in zip(rounds, rounds[1:]):
+        same_headline = m0.get("_metric_name") == m1.get("_metric_name")
+        for name in sorted(set(m0) & set(m1)):
+            direction = metric_direction(name)
+            if direction is None:
+                continue
+            if name in _HEADLINE_KEYS and not same_headline:
+                continue  # the rounds benched different headline workloads
+            prev, cur = m0[name], m1[name]
+            if prev == 0:
+                continue
+            pct = (cur - prev) / abs(prev)
+            bad = pct > threshold if direction < 0 else pct < -threshold
+            if not bad or abs(cur - prev) <= noise_floor(name):
+                continue
+            r = Regression(metric=name, frm=l0, to=l1, prev=prev, cur=cur, pct=pct)
+            if r.key in ack:
+                r.acked, r.reason = True, ack[r.key]
+            out.append(r)
+    return out
+
+
+def compare_rounds(
+    prev: dict[str, float], cur: dict[str, float], *, threshold: float = 0.10,
+) -> tuple[dict[str, float], list[str]]:
+    """One-transition comparison used by ``bench.py`` against the newest
+    committed round: ``(deltas, regressions)`` where ``deltas`` maps each
+    gated metric to its signed relative change and ``regressions`` holds
+    human-readable strings for changes beyond ``threshold`` in the bad
+    direction (noise floors applied)."""
+    same_headline = prev.get("_metric_name") == cur.get("_metric_name")
+    deltas: dict[str, float] = {}
+    regs: list[str] = []
+    for name in sorted(set(prev) & set(cur)):
+        direction = metric_direction(name)
+        if direction is None:
+            continue
+        if name in _HEADLINE_KEYS and not same_headline:
+            continue
+        p, c = prev[name], cur[name]
+        if not isinstance(p, (int, float)) or not isinstance(c, (int, float)) or p == 0:
+            continue
+        pct = (c - p) / abs(p)
+        deltas[name] = round(pct, 4)
+        bad = pct > threshold if direction < 0 else pct < -threshold
+        if bad and abs(c - p) > noise_floor(name):
+            regs.append(f"{name} {p:g} -> {c:g} ({pct * 100:+.1f}%)")
+    return deltas, regs
+
+
+def format_history(rounds: list[tuple[str, dict[str, float]]],
+                   regressions: list[Regression]) -> str:
+    labels = [l for l, _ in rounds]
+    names = sorted({n for _, m in rounds for n in m if metric_direction(n) is not None})
+    w = max((len(n) for n in names), default=10)
+    lines = ["bench history: " + " -> ".join(labels),
+             f"  {'metric':<{w}} " + " ".join(f"{l:>10}" for l in labels)]
+    for n in names:
+        cells = []
+        for _, m in rounds:
+            v = m.get(n)
+            cells.append(f"{v:>10.4g}" if v is not None else f"{'-':>10}")
+        arrow = {1: "^", -1: "v"}[metric_direction(n)]
+        lines.append(f"  {n:<{w}} " + " ".join(cells) + f"  [{arrow}]")
+    if regressions:
+        lines.append("")
+        for r in regressions:
+            lines.append("  " + r.format())
+    else:
+        lines.append("  no regressions beyond threshold")
+    return "\n".join(lines)
+
+
+def run_history_gate(
+    paths: list[str],
+    *,
+    threshold: float = 0.10,
+    ack_path: Optional[str] = None,
+    gate: bool = False,
+    out=sys.stdout,
+) -> int:
+    """The CI entry (also called by scripts/lint_traces.py): print the
+    trajectory + flags; exit 1 only under ``--gate`` with un-acked
+    regressions."""
+    rounds = [load_round(p) for p in sorted(paths)]
+    rounds = [(l, m) for l, m in rounds if m]
+    if len(rounds) < 2:
+        print("perf_report --history: need at least two rounds with metrics", file=out)
+        return 0
+    if ack_path is None:
+        repo_ack = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BENCH_ACK.json")
+        ack_path = repo_ack
+    regs = analyze_history(rounds, threshold=threshold, ack=load_ack(ack_path))
+    print(format_history(rounds, regs), file=out)
+    fresh = [r for r in regs if not r.acked]
+    if fresh:
+        print(
+            f"\nperf_report: {len(fresh)} un-acknowledged regression(s) "
+            f"(threshold {threshold * 100:.0f}%); acknowledge deliberate ones in "
+            f"{os.path.basename(ack_path or 'BENCH_ACK.json')}",
+            file=out,
+        )
+    return 1 if (gate and fresh) else 0
+
+
+# =============================================================================
+# Attribution mode
+# =============================================================================
+
+
+def run_attribution(
+    trace_dir: str,
+    *,
+    steps: int = 1,
+    top_k: int = 10,
+    device: Optional[str] = None,
+    hlo_path: Optional[str] = None,
+    model: Optional[str] = None,
+    batch: int = 2,
+    seq: int = 16,
+    out=sys.stdout,
+) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from thunder_tpu.analysis.cost import cost_report
+    from thunder_tpu.observability.attribution import attribute, join_cost_attribution
+
+    hlo_text = None
+    if hlo_path:
+        with open(hlo_path) as f:
+            hlo_text = f.read()
+    try:
+        attr = attribute(trace_dir, hlo_text=hlo_text)
+    except FileNotFoundError as e:
+        print(f"perf_report: {e}", file=sys.stderr)
+        return 2
+
+    cost = None
+    if model:
+        from thunder_tpu.core import dtypes
+        from thunder_tpu.models import gpt as m
+
+        cfg = m.name_to_config(model)
+        params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+        idx = np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        cost = cost_report(lambda p, i: m.forward(p, i, cfg), params, idx,
+                           executors=["jax"], device=device)
+    join = join_cost_attribution(attr, cost, steps=steps)
+    print(join.format(top_k), file=out)
+    if attr.coverage < 0.9 and attr.device_busy_us:
+        print(
+            f"\nperf_report: only {attr.coverage * 100:.1f}% of device time "
+            "attributed — profile with THUNDER_TPU_ANNOTATE_TRACES=1, or pass "
+            "--hlo <compiled.txt> to join raw HLO op names",
+            file=out,
+        )
+    return 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="perf_report.py",
+        description="Bench-history regression gate and profile attribution reports",
+    )
+    p.add_argument("--history", nargs="+", metavar="BENCH.json",
+                   help="committed bench rounds to diff (BENCH_r*.json)")
+    p.add_argument("--threshold", type=float, default=0.10,
+                   help="relative regression threshold (default 0.10)")
+    p.add_argument("--ack", default=None,
+                   help="acknowledgment file (default: repo-root BENCH_ACK.json)")
+    p.add_argument("--gate", action="store_true",
+                   help="exit 1 on un-acknowledged regressions (CI mode)")
+    p.add_argument("--trace-dir", default=None,
+                   help="profile dir (or one trace-events JSON) to attribute")
+    p.add_argument("--steps", type=int, default=1,
+                   help="steps the profile bracketed (scales totals per step)")
+    p.add_argument("--top", type=int, default=10, help="rows in the top-k table")
+    p.add_argument("--device", default=None,
+                   help="device spec name for the cost model (v5e/v5p/v4/a100/cpu)")
+    p.add_argument("--hlo", default=None,
+                   help="compiled-HLO text file to map raw hlo_op names to scopes")
+    p.add_argument("--model", default=None,
+                   help="GPT config name to build the cost model from (e.g. gpt-tiny)")
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--seq", type=int, default=16)
+    args = p.parse_args(argv)
+
+    if args.history:
+        return run_history_gate(
+            args.history, threshold=args.threshold, ack_path=args.ack, gate=args.gate
+        )
+    if args.trace_dir:
+        return run_attribution(
+            args.trace_dir, steps=args.steps, top_k=args.top, device=args.device,
+            hlo_path=args.hlo, model=args.model, batch=args.batch, seq=args.seq,
+        )
+    p.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -164,7 +164,8 @@ class TestEventLog:
         assert kinds[-1] == "compile_end"
         assert "pass" in kinds
 
-        envelope = {"v", "ts", "seq", "kind"}
+        # pid/host joined the envelope in PR 5 (multi-host log merging).
+        envelope = {"v", "ts", "seq", "kind", "pid", "host"}
         golden = {
             "cache_miss": envelope | {"fn", "call"},
             "compile_start": envelope | {"compile_id", "fn", "cache_option", "call"},
@@ -461,8 +462,10 @@ class TestAnnotatedCodegen:
         jf(np.ones((2, 2), np.float32))
         final = ttpu.last_traces(jf)[-1]
         src = final.python(annotate=True)
-        assert "__annotate_scope('L0.tanh@Delete_Last_Used')" in src
-        assert "L2.sum@Delete_Last_Used" in src
+        # '#' separator: JAX's name stack truncates scope names at '@', which
+        # would strip the pass provenance from HLO metadata (PR 5 fix).
+        assert "__annotate_scope('L0.tanh#Delete_Last_Used')" in src
+        assert "L2.sum#Delete_Last_Used" in src
 
 
 # =============================================================================

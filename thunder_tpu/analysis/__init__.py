@@ -33,7 +33,17 @@ from thunder_tpu.analysis.diagnostics import (  # noqa: F401
     max_severity,
 )
 from thunder_tpu.analysis.context import VerifyContext, pass_name_of  # noqa: F401
-from thunder_tpu.analysis.events import format_replay, replay_events  # noqa: F401
+from thunder_tpu.analysis.cost import (  # noqa: F401
+    DEVICE_SPECS,
+    DeviceSpec,
+    OpCost,
+    TraceCost,
+    bsym_cost,
+    cost_report,
+    resolve_device_spec,
+    trace_cost,
+)
+from thunder_tpu.analysis.events import format_replay, merge_event_logs, replay_events  # noqa: F401
 from thunder_tpu.analysis.registry import (  # noqa: F401
     Rule,
     all_rules,

@@ -1,0 +1,519 @@
+"""Static per-op cost model and roofline analysis over traces.
+
+The *predicted* half of the performance-attribution observatory (the
+*measured* half is ``thunder_tpu/observability/attribution.py``): every
+value-producing BoundSymbol is assigned FLOPs, HBM bytes, and interconnect
+bytes from its tensor metadata alone — no execution — and the rollup is
+scored against a device spec (peak FLOP/s + HBM bandwidth) to yield
+per-op and whole-trace roofline step-time lower bounds:
+
+    t_op >= max(flops / peak_flops, bytes / hbm_bw, comm_bytes / ici_bw)
+
+An op whose arithmetic intensity (flops/byte) exceeds the device ridge
+point (peak/bw) is *compute-bound*; below it, *memory-bound*. Matmuls at
+LLM shapes sit far above the ridge; elementwise/reduction/shape ops sit far
+below — which is why the roofline table, joined with measured device time
+(``monitor.attribution_report``), says whether a slow op is worth a kernel
+or a fusion fix (compute-bound: better MXU utilization; memory-bound: fuse
+away the HBM round-trip).
+
+Conventions (documented so golden tests are exact):
+
+- matmul/linear: ``2·m·n·k`` FLOPs (multiply+add), bias adds counted.
+- SDPA: two T×T matmuls = ``4·B·H·Tq·Tk·D`` plus 5 FLOPs per attention
+  score for the online softmax; causal masks halve both. Flash-claimed
+  SDPA reads only q/k/v and writes only out (+lse) — the T×T score matrix
+  never touches HBM.
+- elementwise: 1 FLOP per output element regardless of transcendence —
+  they are bandwidth-bound on every spec in the table, so FLOP-weighting
+  transcendentals would change no classification while making totals
+  noisier against analytic estimates.
+- reductions: 1 FLOP per *input* element (variance: 2).
+- collectives: 0 FLOPs; ring-algorithm wire bytes — all_reduce moves
+  ``2·(g−1)/g·nbytes``, all_gather/reduce_scatter ``(g−1)/g·nbytes``.
+- pure layout ops (reshape/squeeze/broadcast): free — XLA fuses them;
+  data-moving shape ops (transpose/cat/pad/take/...) are charged in+out
+  bytes at 0 FLOPs.
+
+Device peaks are datasheet numbers; override by passing your own
+:class:`DeviceSpec` (docs/performance.md shows how to add a chip).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+from thunder_tpu.core.prims import OpTags, PrimIDs
+from thunder_tpu.core.proxies import TensorProxy, pyval
+from thunder_tpu.core.trace import TraceCtx
+
+# =============================================================================
+# Device specs
+# =============================================================================
+
+
+@dataclass(frozen=True)
+class DeviceSpec:
+    """Peak numbers for one chip. ``peak_flops`` maps a dtype class
+    ("bf16" — the MXU path for f16/bf16, "f32", "int8") to FLOP/s;
+    ``hbm_bw`` and ``ici_bw`` are bytes/s. Datasheet values — real kernels
+    see less; the roofline is a *lower bound* on step time."""
+
+    name: str
+    peak_flops: dict[str, float]
+    hbm_bw: float
+    ici_bw: float = 0.0
+
+    def peak_for(self, dtype: Any) -> float:
+        return self.peak_flops.get(_dtype_class(dtype), self.peak_flops["bf16"])
+
+    def ridge(self, dtype: Any) -> float:
+        """Arithmetic intensity (FLOP/byte) at which compute and memory
+        time are equal — ops above it are compute-bound."""
+        return self.peak_for(dtype) / self.hbm_bw
+
+
+def _dtype_class(dtype: Any) -> str:
+    nbytes = getattr(dtype, "bytes", 4)
+    if getattr(dtype, "kind", "float") in ("int", "uint", "bool"):
+        return "int8" if nbytes <= 1 else "f32"
+    return "bf16" if nbytes <= 2 else "f32"
+
+
+# Datasheet peaks. f32 on TPU runs through the MXU at roughly half bf16
+# throughput (XLA splits f32 matmuls); "cpu" is a deliberately small spec so
+# host-platform tests still classify sensibly.
+DEVICE_SPECS: dict[str, DeviceSpec] = {
+    "v5e": DeviceSpec("v5e", {"bf16": 197e12, "f32": 98.5e12, "int8": 394e12},
+                      hbm_bw=819e9, ici_bw=186e9),
+    "v5p": DeviceSpec("v5p", {"bf16": 459e12, "f32": 229.5e12, "int8": 918e12},
+                      hbm_bw=2765e9, ici_bw=600e9),
+    "v4": DeviceSpec("v4", {"bf16": 275e12, "f32": 137.5e12, "int8": 275e12},
+                     hbm_bw=1228e9, ici_bw=300e9),
+    "v6e": DeviceSpec("v6e", {"bf16": 918e12, "f32": 459e12, "int8": 1836e12},
+                      hbm_bw=1640e9, ici_bw=448e9),
+    "a100": DeviceSpec("a100", {"bf16": 312e12, "f32": 19.5e12, "int8": 624e12},
+                       hbm_bw=1555e9, ici_bw=600e9),
+    "cpu": DeviceSpec("cpu", {"bf16": 2e11, "f32": 2e11, "int8": 4e11},
+                      hbm_bw=5e10, ici_bw=1e10),
+}
+
+
+def resolve_device_spec(device: Any = None) -> DeviceSpec:
+    """A :class:`DeviceSpec` from a spec object, a table name, or None
+    (autodetect: cpu when the local platform is cpu, else the chip from
+    ``thunder_tpu.benchmarks.tpu_generation()`` — the same sniffing the
+    bench uses, PALLAS_AXON_TPU_GEN env first). An autodetected generation
+    missing from the table warns before falling back to v5e; a *named*
+    unknown spec raises."""
+    if isinstance(device, DeviceSpec):
+        return device
+    if isinstance(device, str):
+        spec = DEVICE_SPECS.get(device.lower())
+        if spec is None:
+            raise ValueError(
+                f"unknown device spec {device!r}; known: {sorted(DEVICE_SPECS)} "
+                "(pass a DeviceSpec to add a chip)"
+            )
+        return spec
+    try:
+        import jax
+
+        if jax.devices()[0].platform == "cpu":
+            return DEVICE_SPECS["cpu"]
+    except Exception:
+        pass
+    from thunder_tpu.benchmarks import tpu_generation
+
+    gen = tpu_generation()
+    spec = DEVICE_SPECS.get(gen)
+    if spec is None:
+        import warnings
+
+        warnings.warn(
+            f"no DeviceSpec for detected chip {gen!r}; roofline numbers will "
+            f"use the v5e spec — pass device=DeviceSpec(...) for real bounds",
+            stacklevel=2,
+        )
+        return DEVICE_SPECS["v5e"]
+    return spec
+
+
+# =============================================================================
+# Per-op cost rules
+# =============================================================================
+
+
+@dataclass
+class OpCost:
+    """Static cost of one BoundSymbol. ``bytes_moved`` is HBM traffic
+    (reads + writes); ``comm_bytes`` is interconnect wire traffic."""
+
+    flops: float = 0.0
+    bytes_moved: float = 0.0
+    comm_bytes: float = 0.0
+    kind: str = "other"
+
+    @property
+    def arithmetic_intensity(self) -> float:
+        return self.flops / self.bytes_moved if self.bytes_moved else float("inf")
+
+
+def _tensor_args(bsym) -> list[TensorProxy]:
+    return [p for p in bsym.flat_proxy_args if isinstance(p, TensorProxy)]
+
+
+def _tensor_outs(bsym) -> list[TensorProxy]:
+    return [p for p in bsym.flat_proxy_outs if isinstance(p, TensorProxy)]
+
+
+def _numel(shape: Sequence[Any]) -> int:
+    n = 1
+    for s in shape:
+        v = pyval(s)
+        n *= int(v) if v is not None else int(s)
+    return n
+
+
+def _io_bytes(bsym) -> float:
+    return float(sum(p.size_bytes for p in _tensor_args(bsym))
+                 + sum(p.size_bytes for p in _tensor_outs(bsym)))
+
+
+def _out_numel(bsym) -> int:
+    return sum(p.numel for p in _tensor_outs(bsym))
+
+
+def _in_numel(bsym) -> int:
+    return sum(p.numel for p in _tensor_args(bsym))
+
+
+# Bookkeeping prims with no runtime cost at all.
+_FREE_IDS = {
+    PrimIDs.DEL, PrimIDs.RETURN, PrimIDs.COMMENT, PrimIDs.PRINT,
+    PrimIDs.UNPACK_TRIVIAL, PrimIDs.UNPACK_SEQUENCE, PrimIDs.UNPACK_KEY,
+    PrimIDs.UNPACK_ATTR, PrimIDs.UNPACK_DIM,
+    PrimIDs.CHECK_TENSOR_SHAPE_AND_METADATA, PrimIDs.CHECK_NUMBER_TYPE_AND_VALUE,
+    PrimIDs.CHECK_STRING_VALUE, PrimIDs.CHECK_LEN, PrimIDs.CHECK_KEYS,
+    PrimIDs.CHECK_NONE, PrimIDs.CHECK_DIM_BUCKET,
+    PrimIDs.SHALLOW_COPY, PrimIDs.STOP_GRADIENT, PrimIDs.ITEM,
+}
+
+# Layout-only ops XLA compiles away (no data movement charged).
+_LAYOUT_IDS = {PrimIDs.RESHAPE, PrimIDs.SQUEEZE, PrimIDs.BROADCAST_IN_DIM}
+
+# Data-moving shape ops: 0 FLOPs, in+out bytes.
+_MOVE_IDS = {
+    PrimIDs.TRANSPOSE, PrimIDs.CAT, PrimIDs.PAD, PrimIDs.SLICE, PrimIDs.FLIP,
+    PrimIDs.TAKE, PrimIDs.TAKE_ALONG_AXIS, PrimIDs.GATHER, PrimIDs.SETITEM,
+    PrimIDs.INDEX_PUT, PrimIDs.TENSOR_FROM_SEQUENCE, PrimIDs.DEVICE_PUT,
+    PrimIDs.CONVERT_ELEMENT_TYPE, PrimIDs.COPY_, PrimIDs.TENSOR_CONSTANT,
+}
+
+# 2-FLOP-per-input-element reductions (mean+var in one pass).
+_VAR_IDS = {PrimIDs.VAR, PrimIDs.VAR_MEAN}
+
+_SDPA_FWD_IDS = {"torch.scaled_dot_product_attention", "torch.sdpa_fwd_res"}
+_SDPA_BWD_IDS = {"torch.sdpa_bwd", "torch.sdpa_bwd_res"}
+
+# Ring-collective wire-traffic factors as a function of group size g.
+_COLLECTIVE_FACTORS: dict[str, Callable[[int], float]] = {
+    "all_reduce": lambda g: 2.0 * (g - 1) / g,
+    "all_gather": lambda g: (g - 1) / g,
+    "reduce_scatter": lambda g: (g - 1) / g,
+    "broadcast": lambda g: (g - 1) / g,
+    "all_to_all": lambda g: (g - 1) / g,
+    "ppermute": lambda g: 1.0,
+    "mask_to_rank": lambda g: 0.0,
+    "synchronize": lambda g: 0.0,
+    "wait": lambda g: 0.0,
+}
+
+
+def _matmul_cost(bsym) -> OpCost:
+    # out (..., m, n) = a (..., m, k) @ b (..., k, n): 2·m·n·k per batch.
+    a = _tensor_args(bsym)[0]
+    k = int(pyval(a.shape[-1]) or a.shape[-1])
+    return OpCost(flops=2.0 * _out_numel(bsym) * k, bytes_moved=_io_bytes(bsym), kind="matmul")
+
+
+def _linear_cost(bsym) -> OpCost:
+    # out (..., n) = a (..., k) @ w.T (k, n) [+ bias]: 2·m·n·k + bias adds.
+    tas = _tensor_args(bsym)
+    a = tas[0]
+    k = int(pyval(a.shape[-1]) or a.shape[-1])
+    out_n = _out_numel(bsym)
+    flops = 2.0 * out_n * k
+    if len(tas) > 2:  # bias present
+        flops += out_n
+    return OpCost(flops=flops, bytes_moved=_io_bytes(bsym), kind="matmul")
+
+
+def _conv_cost(bsym, *, bwd: bool = False) -> OpCost:
+    # out numel × 2 × (cin/groups · ∏kernel); backward does ~2× the work
+    # (grad-input + grad-weight each cost one forward).
+    tas = _tensor_args(bsym)
+    w = tas[1]
+    k_work = _numel(w.shape[1:])  # cin/groups · ∏kernel
+    flops = 2.0 * _out_numel(bsym) * k_work * (2.0 if bwd else 1.0)
+    return OpCost(flops=flops, bytes_moved=_io_bytes(bsym), kind="matmul")
+
+
+def _sdpa_dims(bsym) -> tuple[float, float, float, float, float, bool]:
+    tas = _tensor_args(bsym)
+    q, k = tas[0], tas[1]
+    b = _numel(q.shape[:-2])  # B·H (grouped-query: q carries the full H)
+    tq = int(pyval(q.shape[-2]) or q.shape[-2])
+    tk = int(pyval(k.shape[-2]) or k.shape[-2])
+    d = int(pyval(q.shape[-1]) or q.shape[-1])
+    causal = bool(pyval(bsym.kwargs.get("is_causal", False)) or
+                  any(a is True for a in bsym.args if isinstance(a, bool)))
+    return b, tq, tk, d, 0.5 if causal else 1.0, causal
+
+
+def _sdpa_cost(bsym, *, bwd: bool = False) -> OpCost:
+    b, tq, tk, d, frac, _ = _sdpa_dims(bsym)
+    # QKᵀ and AV: 2·(2·B·H·Tq·Tk·D); online softmax ≈ 5 FLOPs/score.
+    flops = frac * (4.0 * b * tq * tk * d + 5.0 * b * tq * tk)
+    if bwd:
+        # dQ, dK, dV plus the flash re-descent of the forward ≈ 2.5× fwd.
+        flops *= 2.5
+    # Flash kernels never materialize the score matrix: HBM traffic is the
+    # q/k/v/out (+residual) tensors only — exactly the proxy operands.
+    return OpCost(flops=flops, bytes_moved=_io_bytes(bsym), kind="sdpa")
+
+
+def _collective_cost(bsym) -> OpCost:
+    name = bsym.sym.name
+    factor_fn = _COLLECTIVE_FACTORS.get(name)
+    nbytes = float(sum(p.size_bytes for p in _tensor_args(bsym)))
+    if factor_fn is None:
+        return OpCost(comm_bytes=nbytes, kind="collective")
+    g = 1
+    for a in bsym.flat_args:
+        v = pyval(a)
+        if isinstance(v, int) and not isinstance(v, bool) and v > 1:
+            g = v
+            break
+    return OpCost(comm_bytes=factor_fn(g) * nbytes, kind="collective")
+
+
+def bsym_cost(bsym) -> Optional[OpCost]:
+    """Static cost of one BoundSymbol, or None for pure bookkeeping
+    (unpacks, guards, del/return). Dispatches on the prim id, the
+    executor-claimed symbol id (SDPA family), and the COMM_OP tag."""
+    sid = bsym.sym.id
+    if sid in _FREE_IDS:
+        return None
+    if OpTags.COMM_OP in bsym.sym.tags:
+        return _collective_cost(bsym)
+    if isinstance(sid, str):
+        if sid in _SDPA_FWD_IDS:
+            return _sdpa_cost(bsym)
+        if sid in _SDPA_BWD_IDS:
+            return _sdpa_cost(bsym, bwd=True)
+    if sid is PrimIDs.MATMUL:
+        return _matmul_cost(bsym)
+    if sid is PrimIDs.LINEAR:
+        return _linear_cost(bsym)
+    if sid is PrimIDs.CONVOLUTION:
+        return _conv_cost(bsym)
+    if sid is PrimIDs.CONVOLUTION_BWD:
+        return _conv_cost(bsym, bwd=True)
+    if sid in (PrimIDs.EMBEDDING, PrimIDs.EMBEDDING_BACKWARD):
+        return OpCost(bytes_moved=_io_bytes(bsym), kind="gather")
+    if sid in _LAYOUT_IDS:
+        return OpCost(kind="layout")
+    if sid in _MOVE_IDS:
+        return OpCost(bytes_moved=_io_bytes(bsym), kind="shape")
+    if not _tensor_outs(bsym):
+        return None
+    tags = bsym.sym.tags
+    if OpTags.REDUCTION_OP in tags or sid in _VAR_IDS or sid in (
+        PrimIDs.SUM, PrimIDs.PROD, PrimIDs.AMAX, PrimIDs.AMIN,
+        PrimIDs.ARGMAX, PrimIDs.ARGMIN, PrimIDs.VAR, PrimIDs.VAR_MEAN,
+        PrimIDs.CUMSUM, PrimIDs.CUMPROD,
+    ):
+        mult = 2.0 if sid in _VAR_IDS else 1.0
+        return OpCost(flops=mult * _in_numel(bsym), bytes_moved=_io_bytes(bsym),
+                      kind="reduction")
+    if sid in (PrimIDs.SORT, PrimIDs.ARGSORT, PrimIDs.TOPK):
+        return OpCost(flops=float(_in_numel(bsym)), bytes_moved=_io_bytes(bsym),
+                      kind="sort")
+    if sid in (PrimIDs.FULL, PrimIDs.IOTA, PrimIDs.UNIFORM, PrimIDs.RANDN,
+               PrimIDs.UNIFORM_KEYED, PrimIDs.RANDN_KEYED, PrimIDs.UNIFORM_PHILOX):
+        return OpCost(
+            flops=float(_out_numel(bsym)),
+            bytes_moved=float(sum(p.size_bytes for p in _tensor_outs(bsym))),
+            kind="fill",
+        )
+    # Elementwise (and the unknown-op fallback): 1 FLOP per output element.
+    kind = "elementwise" if (
+        OpTags.ELEMENTWISE_UNARY_OP in tags or OpTags.ELEMENTWISE_BINARY_OP in tags
+        or sid is PrimIDs.WHERE
+    ) else "other"
+    return OpCost(flops=float(_out_numel(bsym)), bytes_moved=_io_bytes(bsym), kind=kind)
+
+
+# =============================================================================
+# Trace rollup + roofline
+# =============================================================================
+
+
+@dataclass
+class OpCostRow:
+    """One trace line's cost, scored against the device spec."""
+
+    index: int
+    sym: str
+    kind: str
+    flops: float
+    bytes_moved: float
+    comm_bytes: float
+    roofline_s: float
+    bound: str  # "compute" | "memory" | "comm" | "free"
+    intensity: float
+    line: str = ""
+
+
+@dataclass
+class TraceCost:
+    """Cost rollup of one trace against one device spec."""
+
+    device: DeviceSpec
+    rows: list[OpCostRow] = field(default_factory=list)
+    total_flops: float = 0.0
+    total_bytes: float = 0.0
+    total_comm_bytes: float = 0.0
+    # Σ flops/peak at each op's OWN dtype peak (accumulated by trace_cost so
+    # the pure-compute bound agrees with the per-row roofline terms — a
+    # bf16 trace must not be scored at the f32 peak here).
+    _compute_s: float = 0.0
+
+    @property
+    def roofline_s(self) -> float:
+        """Step-time lower bound with no cross-op fusion: Σ per-op bounds."""
+        return sum(r.roofline_s for r in self.rows)
+
+    @property
+    def compute_s(self) -> float:
+        """Pure-compute bound (every byte free), at per-op dtype peaks."""
+        return self._compute_s
+
+    @property
+    def memory_s(self) -> float:
+        """Pure-bandwidth bound (every FLOP free)."""
+        return self.total_bytes / self.device.hbm_bw
+
+    def by_kind(self) -> dict[str, dict]:
+        out: dict[str, dict] = {}
+        for r in self.rows:
+            d = out.setdefault(r.kind, {"flops": 0.0, "bytes": 0.0, "roofline_s": 0.0, "ops": 0})
+            d["flops"] += r.flops
+            d["bytes"] += r.bytes_moved
+            d["roofline_s"] += r.roofline_s
+            d["ops"] += 1
+        return out
+
+    def mfu_at(self, measured_s: float) -> float:
+        """Model FLOPs utilization if the trace ran once in ``measured_s``."""
+        return self.total_flops / measured_s / self.device.peak_flops["bf16"] if measured_s else 0.0
+
+    def top(self, k: int = 10) -> list[OpCostRow]:
+        return sorted(self.rows, key=lambda r: r.roofline_s, reverse=True)[:k]
+
+    def format(self, top_k: int = 10) -> str:
+        dev = self.device
+        lines = [
+            f"cost model [{dev.name}: {dev.peak_flops['bf16'] / 1e12:.0f} bf16 TFLOP/s, "
+            f"{dev.hbm_bw / 1e9:.0f} GB/s HBM]",
+            f"  total: {self.total_flops / 1e9:.3f} GFLOP, "
+            f"{self.total_bytes / 1e6:.2f} MB moved"
+            + (f", {self.total_comm_bytes / 1e6:.2f} MB on ICI" if self.total_comm_bytes else ""),
+            f"  roofline step-time bound: {self.roofline_s * 1e3:.3f} ms unfused "
+            f"(compute {self.compute_s * 1e3:.3f} ms, memory {self.memory_s * 1e3:.3f} ms)",
+            f"  {'line':>5} {'sym':<28} {'kind':<12} {'GFLOP':>10} {'MB':>9} "
+            f"{'AI':>8} {'bound':>8} {'us':>9}",
+        ]
+        for r in self.top(top_k):
+            ai = f"{r.intensity:.1f}" if r.intensity != float("inf") else "inf"
+            lines.append(
+                f"  L{r.index:>4} {r.sym:<28.28} {r.kind:<12} {r.flops / 1e9:>10.4f} "
+                f"{r.bytes_moved / 1e6:>9.3f} {ai:>8} {r.bound:>8} {r.roofline_s * 1e6:>9.1f}"
+            )
+        kinds = self.by_kind()
+        if kinds:
+            lines.append("  by kind: " + ", ".join(
+                f"{k}={v['roofline_s'] * 1e6:.0f}us/{v['ops']}ops"
+                for k, v in sorted(kinds.items(), key=lambda kv: -kv[1]["roofline_s"])
+            ))
+        return "\n".join(lines)
+
+    def __str__(self) -> str:
+        return self.format()
+
+
+def trace_cost(trace: TraceCtx, device: Any = None) -> TraceCost:
+    """Roll :func:`bsym_cost` up over ``trace`` and score each op against
+    ``device`` (a :class:`DeviceSpec`, a name from ``DEVICE_SPECS``, or
+    None to autodetect the local chip)."""
+    dev = resolve_device_spec(device)
+    tc = TraceCost(device=dev)
+    for i, bsym in enumerate(trace.bound_symbols):
+        c = bsym_cost(bsym)
+        if c is None:
+            continue
+        outs = _tensor_outs(bsym)
+        dtype = outs[0].dtype if outs else None
+        t_compute = c.flops / dev.peak_for(dtype)
+        t_memory = c.bytes_moved / dev.hbm_bw
+        t_comm = c.comm_bytes / dev.ici_bw if dev.ici_bw and c.comm_bytes else 0.0
+        t = max(t_compute, t_memory, t_comm)
+        if t == 0.0:
+            bound = "free"
+        elif t == t_comm:
+            bound = "comm"
+        elif t == t_compute:
+            bound = "compute"
+        else:
+            bound = "memory"
+        tc.rows.append(OpCostRow(
+            index=i, sym=bsym.sym.name, kind=c.kind, flops=c.flops,
+            bytes_moved=c.bytes_moved, comm_bytes=c.comm_bytes,
+            roofline_s=t, bound=bound, intensity=c.arithmetic_intensity,
+            line=bsym.one_line(),
+        ))
+        tc.total_flops += c.flops
+        tc.total_bytes += c.bytes_moved
+        tc.total_comm_bytes += c.comm_bytes
+        tc._compute_s += t_compute
+    return tc
+
+
+def cost_report(fn: Callable, *args, executors: Any = None, device: Any = None,
+                **kwargs) -> TraceCost:
+    """Trace ``fn`` on the example inputs through the default pass pipeline
+    (acquisition → DCE → CSE → claiming) and return the :class:`TraceCost`
+    of the resulting execution trace — the static half of the attribution
+    workflow (``examine.cost_report`` re-exports this; docs/performance.md).
+
+    For an already-compiled ``thunder_tpu.jit`` function, the underlying
+    function is traced (mirroring ``examine.lint``); to cost the exact
+    trace an entry executed, call :func:`trace_cost` on
+    ``compile_stats(jfn).last_traces[-1]`` instead."""
+    from thunder_tpu.api import trace_program
+    from thunder_tpu.core.trace import debug_checks
+    from thunder_tpu.executors.passes import transform_for_execution
+    from thunder_tpu.extend import resolve_executors
+    from thunder_tpu.transforms.common import cse, dce
+
+    cd = getattr(fn, "_lc_cd", None)
+    if cd is not None:
+        fn = cd.fn
+    with debug_checks(False):
+        _, comp = trace_program(fn, args, kwargs)
+        comp = cse(dce(comp))
+        extrace = transform_for_execution(comp, resolve_executors(executors))
+    return trace_cost(extrace, device)

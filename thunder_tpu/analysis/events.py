@@ -42,13 +42,71 @@ SCHEMA: dict[str, frozenset] = {
 _COMMON = frozenset({"v", "ts", "seq", "kind"})
 
 
+def _parse_log_lines(path: str, diags: list[Diagnostic]) -> list[tuple[int, dict]]:
+    """(lineno, record) pairs from one JSONL log; malformed lines become
+    diagnostics (tagged with the path when several logs are merged)."""
+    out: list[tuple[int, dict]] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                diags.append(Diagnostic(
+                    rule="events.malformed-line", severity=Severity.ERROR,
+                    message=f"{path}:{lineno}: not valid JSON ({e})",
+                ))
+                continue
+            out.append((lineno, rec))
+    return out
+
+
+def merge_event_logs(paths: list[str]) -> tuple[list[dict], list[Diagnostic]]:
+    """Merge several per-host JSONL logs (multi-host jobs write one log per
+    process; every record carries ``pid``/``host`` — observability/events.py)
+    into one deterministically-ordered stream.
+
+    Ordering is stable across re-runs of the merge: (ts, host, pid, seq) —
+    wall-clock first so interleaved compiles read chronologically, then
+    writer identity, then the writer's own monotonic ``seq`` to break
+    same-timestamp ties. Returns (records, parse diagnostics)."""
+    def num(v, cast) -> float:
+        # A record with a non-numeric ts/host/pid/seq is still one record:
+        # the schema validator downstream flags it; the merge must not die.
+        try:
+            return cast(v or 0)
+        except (TypeError, ValueError):
+            return cast(0)
+
+    diags: list[Diagnostic] = []
+    records: list[tuple[tuple, int, dict]] = []
+    for path in paths:
+        for lineno, rec in _parse_log_lines(path, diags):
+            if isinstance(rec, dict):
+                key = (
+                    num(rec.get("ts"), float),
+                    num(rec.get("host"), int),
+                    num(rec.get("pid"), int),
+                    num(rec.get("seq"), int),
+                )
+            else:
+                key = (0.0, 0, 0, 0)
+            records.append((key, lineno, rec))
+    records.sort(key=lambda t: (t[0], t[1]))
+    return [rec for _, _, rec in records], diags
+
+
 def replay_events(
-    path: str,
+    path,
     *,
     storm_threshold: int = 4,
     strict_kinds: bool = False,
 ) -> tuple[dict, list[Diagnostic]]:
-    """Parse + validate ``path``; return ``(summary, diagnostics)``.
+    """Parse + validate ``path`` (one log path, or a list of per-host log
+    paths merged via :func:`merge_event_logs`); return
+    ``(summary, diagnostics)``.
 
     ``summary``: event/kind counts, per-function compile counts, per-pass
     total milliseconds, bucket selections, sharp-edge messages.
@@ -70,20 +128,26 @@ def replay_events(
     sharp_edges: list[str] = []
     n_lines = 0
 
-    with open(path) as f:
-        for lineno, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
+    merged = isinstance(path, (list, tuple)) and len(path) != 1
+    if isinstance(path, (list, tuple)):
+        src = ", ".join(path)
+        records, parse_diags = merge_event_logs(list(path))
+        diags.extend(parse_diags)
+        labeled = list(enumerate(records, 1))
+    else:
+        src = path
+        labeled = _parse_log_lines(path, diags)
+
+    def _writer(rec: dict) -> tuple:
+        # compile_id is a per-process counter: correlation must key on the
+        # writer identity too once several hosts' logs are merged.
+        return (rec.get("host") or 0, rec.get("pid") or 0)
+
+    def _fn_key(rec: dict, fn: str) -> str:
+        return f"h{rec.get('host') or 0}:{fn}" if merged else fn
+
+    for lineno, rec in labeled:
             n_lines += 1
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError as e:
-                diags.append(Diagnostic(
-                    rule="events.malformed-line", severity=Severity.ERROR,
-                    message=f"line {lineno}: not valid JSON ({e})",
-                ))
-                continue
             if not isinstance(rec, dict) or "kind" not in rec:
                 diags.append(Diagnostic(
                     rule="events.malformed-record", severity=Severity.ERROR,
@@ -115,13 +179,14 @@ def replay_events(
                 continue
 
             if kind == "compile_start":
-                fn = str(rec["fn"])
+                fn = _fn_key(rec, str(rec["fn"]))
+                cid = (*_writer(rec), rec["compile_id"])
                 compiles_by_fn[fn] = compiles_by_fn.get(fn, 0) + 1
-                open_compiles[rec["compile_id"]] = fn
-                cache_option_by_cid[rec["compile_id"]] = str(rec["cache_option"])
+                open_compiles[cid] = fn
+                cache_option_by_cid[cid] = str(rec["cache_option"])
             elif kind == "compile_end":
-                fn = str(rec["fn"])
-                cid = rec["compile_id"]
+                fn = _fn_key(rec, str(rec["fn"]))
+                cid = (*_writer(rec), rec["compile_id"])
                 open_compiles.pop(cid, None)
                 if rec.get("recompile"):
                     recompiles_by_fn[fn] = recompiles_by_fn.get(fn, 0) + 1
@@ -144,7 +209,7 @@ def replay_events(
                     pass_ms[rec["name"]] = pass_ms.get(rec["name"], 0.0) + float(rec["ms"])
             elif kind == "bucket_select":
                 buckets.append(str(rec["buckets"]))
-                bucket_by_cid[rec["compile_id"]] = str(rec["buckets"])
+                bucket_by_cid[(*_writer(rec), rec["compile_id"])] = str(rec["buckets"])
             elif kind == "sharp_edge":
                 sharp_edges.append(str(rec["message"]))
 
@@ -189,11 +254,11 @@ def replay_events(
     for cid, fn in open_compiles.items():
         diags.append(Diagnostic(
             rule="events.unclosed-compile", severity=Severity.WARNING,
-            message=f"compile {cid} of {fn!r} has no compile_end (crashed mid-compile?)",
+            message=f"compile {cid[-1]} of {fn!r} has no compile_end (crashed mid-compile?)",
         ))
 
     summary = {
-        "path": path,
+        "path": src,
         "lines": n_lines,
         "kinds": kinds,
         "compiles_by_fn": compiles_by_fn,

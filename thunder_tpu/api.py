@@ -1327,6 +1327,14 @@ def jit(
         result = _run_entry(entry, flat_inps)
         entry.stats.first_run_s = (timer_ns() - run_start) / 1e9
         cs.first_run_seconds += entry.stats.first_run_s
+        if obsm.enabled():
+            # The entry's first run is where jax.jit actually compiles: this
+            # is the end-to-end XLA compile cost per compile class — the
+            # total that can silently double while per-pass ms stays flat.
+            obsm.XLA_COMPILE_S.observe(
+                entry.stats.first_run_s,
+                cls="bucketed" if entry.sym_spec is not None else "exact",
+            )
         if entry.epilogue_fn is not None:
             result = entry.epilogue_fn(args, kwargs, flat_inps, result)
         cs.last_trace_host_stop = timer_ns()

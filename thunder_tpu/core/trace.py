@@ -131,9 +131,12 @@ class TraceCtx:
         metadata and profiler timelines (reference: thunder/core/profile.py:15
         `add_markers` via torch.profiler/NVTX, env THUNDER_ANNOTATE_TRACES).
         The scope name carries the trace-line index and the pass provenance
-        (``L<idx>.<sym>@<pass>``), so a profiler row maps back to BOTH the
+        (``L<idx>.<sym>#<pass>``), so a profiler row maps back to BOTH the
         generated line and the transform that produced it
-        (docs/observability.md)."""
+        (docs/observability.md). The separator is ``#`` — not ``@`` — because
+        JAX's name stack silently truncates scope names at ``@`` before they
+        reach HLO metadata, which would drop the pass provenance from every
+        profile (observability/attribution.py parses both spellings)."""
         lines: list[str] = []
         if include_header:
             if self.provenance is not None:
@@ -146,7 +149,7 @@ class TraceCtx:
         tag = self._annotate_tag() if annotate else ""
         for i, bsym in enumerate(self.bound_symbols):
             if annotate and bsym.flat_proxy_outs:
-                scope = f"L{i}.{bsym.sym.name}@{tag}"
+                scope = f"L{i}.{bsym.sym.name}#{tag}"
                 body.append(f"{baseutils.indent(1)}with __annotate_scope({scope!r}):")
                 body.extend(bsym.python(indent=2, print_depth=print_depth))
             else:

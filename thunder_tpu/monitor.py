@@ -58,3 +58,38 @@ def set_event_log(path: Optional[str]) -> None:
     from thunder_tpu.observability.events import set_global_path
 
     set_global_path(path)
+
+
+def attribution_report(
+    trace_dir: str,
+    *,
+    jfn=None,
+    trace=None,
+    device=None,
+    steps: int = 1,
+    hlo_text: Optional[str] = None,
+):
+    """The roofline/MFU report over a profile directory: measured per-op
+    device time (``observability/attribution.py``) joined with the static
+    cost model (``analysis/cost.py``).
+
+    ``trace_dir`` is a ``thunder_tpu.profile()`` output dir (profile with
+    ``THUNDER_TPU_ANNOTATE_TRACES=1`` so HLO rows carry trace-line scopes).
+    Pass ``jfn`` (a compiled ``thunder_tpu.jit`` function) or ``trace`` (an
+    execution ``TraceCtx``) to add predicted cost, roofline ratio, and
+    compute/memory-bound classification per op; ``steps`` is how many steps
+    the profile bracketed (``profile()``'s ``steps=``), so measured totals
+    scale to per-step numbers. Returns a ``PerfJoin``; ``print(report)`` or
+    ``report.format(top_k)`` renders the table. CLI spelling:
+    ``scripts/perf_report.py --trace-dir DIR``."""
+    from thunder_tpu.analysis.cost import trace_cost
+    from thunder_tpu.observability.attribution import attribute, join_cost_attribution
+
+    if trace is None and jfn is not None:
+        cs = getattr(jfn, "_lc_cs", None)
+        if cs is not None and getattr(cs, "last_traces", None):
+            trace = cs.last_traces[-1]
+    cost = trace_cost(trace, device) if trace is not None else None
+    attr = attribute(trace_dir, hlo_text=hlo_text)
+    join = join_cost_attribution(attr, cost, steps=steps)
+    return join

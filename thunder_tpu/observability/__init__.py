@@ -19,6 +19,11 @@ CompileStats/last_traces/TraceProvenance + profile.py NVTX markers):
 - :mod:`~thunder_tpu.observability.profile` — ``thunder_tpu.profile(fn,
   *args)``: jax.profiler-bracketed steps → an xprof-ready trace dir;
   annotated codegen stamps trace-line + pass provenance into HLO metadata.
+- :mod:`~thunder_tpu.observability.attribution` — parses the profiler's
+  trace-events and aggregates measured device time back onto trace lines
+  (``L<idx>.<sym>#<pass>`` scopes), joinable with the static cost model
+  (``thunder_tpu/analysis/cost.py``) into the roofline/MFU report exposed
+  as ``thunder_tpu.monitor.attribution_report()``.
 
 Import structure: ``metrics`` and ``events`` are stdlib-only (safe to import
 from ``core/trace.py`` and ``common.py`` without cycles); ``instrument`` and
@@ -39,6 +44,12 @@ _LAZY = {
     "InstrumentationHook": "thunder_tpu.observability.instrument",
     "instrument_reports": "thunder_tpu.observability.instrument",
     "profile": "thunder_tpu.observability.profile",
+    "Attribution": "thunder_tpu.observability.attribution",
+    "ScopeRef": "thunder_tpu.observability.attribution",
+    "attribute": "thunder_tpu.observability.attribution",
+    "parse_scope": "thunder_tpu.observability.attribution",
+    "hlo_scope_map": "thunder_tpu.observability.attribution",
+    "join_cost_attribution": "thunder_tpu.observability.attribution",
 }
 
 

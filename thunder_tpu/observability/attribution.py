@@ -1,0 +1,467 @@
+"""Device-time attribution: profiler trace-events → BoundSymbols.
+
+The *measured* half of the performance-attribution observatory (the
+*predicted* half is ``thunder_tpu/analysis/cost.py``). A profile captured
+under ``thunder_tpu.profile()`` with ``THUNDER_TPU_ANNOTATE_TRACES=1``
+carries the annotated-codegen scope ``L<idx>.<sym>#<pass>`` in every HLO
+op's metadata; this module parses the xprof trace-events JSON the profiler
+writes (``plugins/profile/<run>/<host>.trace.json.gz``), selects the
+device-execution events, and aggregates measured device time back onto the
+generated trace lines — closing the loop the PR 3 docstring left open
+("parse per-HLO-op self times with xprof by hand").
+
+Scope parsing accepts three spellings:
+
+- ``L<idx>.<sym>#<pass>`` — current annotated codegen (core/trace.py);
+- ``L<idx>.<sym>@<pass>`` — the PR 3 spelling, kept for old fixtures
+  (JAX truncates ``@...`` before HLO metadata, so live profiles never
+  contain it — but event logs and tests might);
+- ``L<idx>.<sym>`` — the truncated form JAX produced for PR 3 profiles
+  (provenance lost; attributed with ``pass_name=None``).
+
+Backends whose trace events carry only raw HLO op names (the CPU plugin
+emits ``{"args": {"hlo_op": "dot.3"}}`` with no scope path) are joined
+through :func:`hlo_scope_map`, which recovers ``hlo_op → scope`` from the
+compiled module's HLO text (``jax.jit(f).lower(...).compile().as_text()``).
+
+Fused ops that cover several trace lines (one fusion whose metadata lists
+multiple scopes) split their duration evenly across the matched scopes and
+are additionally reported as fusion groups.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+# Scope with provenance: L<idx>.<sym>(#|@)<pass>. Symbol names may be dotted
+# (executor ops like torch.sdpa_fwd_res).
+_SCOPE_RE = re.compile(r"L(\d+)\.([A-Za-z_][\w.]*?)[#@]([\w]+)")
+# Truncated legacy scope (JAX ate '@<pass>'): L<idx>.<sym> at a path-segment
+# boundary.
+_SCOPE_BARE_RE = re.compile(r"L(\d+)\.([A-Za-z_][\w.]*?)(?=/|$)")
+
+# Event names that are device time but not attributable work.
+_IDLE_NAMES = {"idle", "Idle", "IDLE"}
+
+
+@dataclass(frozen=True)
+class ScopeRef:
+    """One parsed ``L<idx>.<sym>[#<pass>]`` scope."""
+
+    line: int
+    sym: str
+    pass_name: Optional[str] = None
+
+    @property
+    def label(self) -> str:
+        p = f"#{self.pass_name}" if self.pass_name else ""
+        return f"L{self.line}.{self.sym}{p}"
+
+
+def parse_scope(name: str) -> Optional[ScopeRef]:
+    """First scope reference in ``name`` (a profiler event name or an HLO
+    ``op_name`` path like ``jit_step/L3.linear#Transform_for_execution/dot``),
+    or None."""
+    refs = parse_scopes(name)
+    return refs[0] if refs else None
+
+
+def parse_scopes(name: str) -> list[ScopeRef]:
+    """Every scope reference in ``name`` — a fused op's metadata may carry
+    several. Provenance-bearing matches win over truncated ones covering the
+    same span."""
+    if not name:
+        return []
+    refs: list[ScopeRef] = []
+    spans: list[tuple[int, int]] = []
+    for m in _SCOPE_RE.finditer(name):
+        refs.append(ScopeRef(int(m.group(1)), m.group(2), m.group(3)))
+        spans.append(m.span())
+    for m in _SCOPE_BARE_RE.finditer(name):
+        if any(a <= m.start() < b for a, b in spans):
+            continue
+        refs.append(ScopeRef(int(m.group(1)), m.group(2), None))
+    return refs
+
+
+# =============================================================================
+# Trace-events loading
+# =============================================================================
+
+
+def find_trace_files(path: str) -> list[str]:
+    """The trace-events JSON file(s) under ``path`` — a profile dir from
+    ``thunder_tpu.profile()`` (searched recursively for
+    ``*.trace.json[.gz]``), or a single file."""
+    if os.path.isfile(path):
+        return [path]
+    out: list[str] = []
+    for pat in ("**/*.trace.json.gz", "**/*.trace.json"):
+        out.extend(glob.glob(os.path.join(path, pat), recursive=True))
+    return sorted(out)
+
+
+def load_trace_events(path: str) -> list[dict]:
+    """Raw trace-event dicts from one Chrome-trace JSON file (gzipped or
+    plain; top-level ``{"traceEvents": [...]}`` or a bare list)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        return doc.get("traceEvents", [])
+    return doc
+
+
+# =============================================================================
+# Attribution
+# =============================================================================
+
+
+@dataclass
+class Attribution:
+    """Measured device time aggregated per trace line / symbol / pass."""
+
+    by_line: dict[ScopeRef, float] = field(default_factory=dict)  # scope -> us
+    counts: dict[ScopeRef, int] = field(default_factory=dict)
+    by_sym: dict[str, float] = field(default_factory=dict)
+    by_pass: dict[str, float] = field(default_factory=dict)
+    fusions: dict[str, tuple[float, tuple[ScopeRef, ...]]] = field(default_factory=dict)
+    unattributed: dict[str, float] = field(default_factory=dict)  # op name -> us
+    device_busy_us: float = 0.0  # non-idle device time
+    idle_us: float = 0.0
+    files: list[str] = field(default_factory=list)
+
+    @property
+    def attributed_us(self) -> float:
+        return sum(self.by_line.values())
+
+    @property
+    def coverage(self) -> float:
+        """Fraction of non-idle device time attributed to named trace lines."""
+        return self.attributed_us / self.device_busy_us if self.device_busy_us else 0.0
+
+    @property
+    def with_provenance_us(self) -> float:
+        return sum(us for ref, us in self.by_line.items() if ref.pass_name)
+
+    def top(self, k: int = 10) -> list[tuple[ScopeRef, float]]:
+        return sorted(self.by_line.items(), key=lambda kv: -kv[1])[:k]
+
+    def format(self, top_k: int = 10) -> str:
+        lines = [
+            f"attribution: {self.device_busy_us / 1e3:.3f} ms device-busy over "
+            f"{len(self.files)} trace file(s), {self.coverage * 100:.1f}% attributed "
+            f"to {len(self.by_line)} trace lines"
+            + (f", {self.idle_us / 1e3:.3f} ms idle" if self.idle_us else ""),
+            f"  {'line':<34} {'calls':>6} {'us':>10} {'share':>7}",
+        ]
+        for ref, us in self.top(top_k):
+            share = us / self.device_busy_us * 100 if self.device_busy_us else 0.0
+            lines.append(
+                f"  {ref.label:<34.34} {self.counts.get(ref, 0):>6} {us:>10.1f} {share:>6.1f}%"
+            )
+        if self.unattributed:
+            worst = sorted(self.unattributed.items(), key=lambda kv: -kv[1])[:3]
+            lines.append("  unattributed: " + ", ".join(f"{n}={us:.0f}us" for n, us in worst))
+        if self.fusions:
+            lines.append(f"  fusion groups spanning several lines: {len(self.fusions)}")
+        return "\n".join(lines)
+
+    def __str__(self) -> str:
+        return self.format()
+
+
+def _is_device_pid(process_names: dict, pid: Any) -> bool:
+    name = str(process_names.get(pid, ""))
+    return "/device:" in name or name.startswith("/tpu") or "TPU" in name
+
+
+def _self_times(device_ops: list[dict]) -> dict[int, float]:
+    """Self time (dur minus nested children) per event, keyed by ``id(ev)``.
+
+    Trace events nest: the CPU plugin emits an XLA ``call`` wrapper whose
+    interval contains the ops it calls, and TPU timelines bracket kernels
+    inside scope rows. Charging raw durations would double-count every
+    nested microsecond, so each event is charged only the time not covered
+    by a child on the same (pid, tid)."""
+    out: dict[int, float] = {}
+    by_tid: dict[tuple, list[dict]] = {}
+    for ev in device_ops:
+        by_tid.setdefault((ev.get("pid"), ev.get("tid")), []).append(ev)
+    for evs in by_tid.values():
+        # Parents sort before their children: earlier start first, longer
+        # duration first on ties.
+        evs.sort(key=lambda e: (float(e.get("ts", 0.0)), -float(e.get("dur", 0.0))))
+        stack: list[tuple[float, int]] = []  # (end_ts, id) of open intervals
+        for ev in evs:
+            ts = float(ev.get("ts", 0.0))
+            dur = float(ev.get("dur", 0.0))
+            eps = 1e-6  # float slack on interval ends
+            while stack and stack[-1][0] <= ts + eps:
+                stack.pop()
+            out[id(ev)] = dur
+            if stack:
+                out[stack[-1][1]] -= dur  # direct parent loses this child's span
+            stack.append((ts + dur, id(ev)))
+    return out
+
+
+def _is_device_op(ev: dict, process_names: dict, thread_names: dict) -> bool:
+    """Does this complete-event represent device execution of an HLO op?
+
+    TPU xprof: op events live on pids named ``/device:TPU:N``. CPU plugin:
+    there is no device pid — XLA execution runs on ``tf_XLAEigen`` threads
+    and each op event carries ``args.hlo_op``/``hlo_module``."""
+    if ev.get("ph") != "X" or not ev.get("dur"):
+        return False
+    args = ev.get("args")
+    if isinstance(args, dict) and ("hlo_op" in args or "hlo_module" in args):
+        return True
+    if _is_device_pid(process_names, ev.get("pid")):
+        # Step markers and scope brackets on device timelines have no args
+        # and huge durations; HLO op rows always name an op. Keep everything
+        # with a name that is not a step annotation.
+        return bool(ev.get("name"))
+    return False
+
+
+def attribute(
+    source: str,
+    *,
+    hlo_text: Optional[str] = None,
+    extra_scope_map: Optional[dict[str, str]] = None,
+) -> Attribution:
+    """Aggregate measured device time per trace line from the profile at
+    ``source`` (a ``thunder_tpu.profile()`` trace dir, or one trace-events
+    JSON file).
+
+    ``hlo_text``: optional compiled-HLO text (``lowered.compile().as_text()``)
+    used to map raw HLO op names to scopes when the backend's trace events
+    don't carry the scope path themselves (the CPU plugin).
+    ``extra_scope_map``: pre-built ``hlo_op → scope-string`` entries merged
+    over the ``hlo_text`` map."""
+    files = find_trace_files(source)
+    if not files:
+        raise FileNotFoundError(f"no *.trace.json[.gz] under {source!r}")
+    scope_map: dict[str, str] = {}
+    if hlo_text:
+        scope_map.update(hlo_scope_map(hlo_text))
+    if extra_scope_map:
+        scope_map.update(extra_scope_map)
+
+    attr = Attribution(files=files)
+    for path in files:
+        events = load_trace_events(path)
+        process_names: dict[Any, str] = {}
+        thread_names: dict[tuple, str] = {}
+        for ev in events:
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    process_names[ev.get("pid")] = ev.get("args", {}).get("name", "")
+                elif ev.get("name") == "thread_name":
+                    thread_names[(ev.get("pid"), ev.get("tid"))] = ev.get("args", {}).get("name", "")
+        device_ops = [ev for ev in events if _is_device_op(ev, process_names, thread_names)]
+        self_us = _self_times(device_ops)
+        for ev in device_ops:
+            name = str(ev.get("name", ""))
+            dur = self_us[id(ev)]
+            if dur <= 0.0:
+                continue
+            args = ev.get("args") if isinstance(ev.get("args"), dict) else {}
+            hlo_op = str(args.get("hlo_op", "")) if args else ""
+            if name in _IDLE_NAMES or hlo_op in _IDLE_NAMES:
+                attr.idle_us += dur
+                continue
+            attr.device_busy_us += dur
+            # Scope source, in order: the event name (TPU op rows carry the
+            # full metadata path), then each arg value on its own (xprof
+            # puts fused long names in args; parsing per-string keeps the
+            # bare-scope regex's end-of-string anchor working for truncated
+            # legacy names), then the HLO-text join on hlo_op/name.
+            refs = parse_scopes(name)
+            if not refs and args:
+                for v in args.values():
+                    refs.extend(parse_scopes(str(v)))
+            if not refs and scope_map:
+                mapped = scope_map.get(hlo_op) or scope_map.get(name)
+                if mapped:
+                    refs = parse_scopes(mapped)
+            if not refs:
+                key = hlo_op or name
+                attr.unattributed[key] = attr.unattributed.get(key, 0.0) + dur
+                continue
+            share = dur / len(refs)
+            for ref in refs:
+                attr.by_line[ref] = attr.by_line.get(ref, 0.0) + share
+                attr.counts[ref] = attr.counts.get(ref, 0) + 1
+                attr.by_sym[ref.sym] = attr.by_sym.get(ref.sym, 0.0) + share
+                if ref.pass_name:
+                    attr.by_pass[ref.pass_name] = attr.by_pass.get(ref.pass_name, 0.0) + share
+            if len(refs) > 1:
+                prev = attr.fusions.get(name, (0.0, tuple(refs)))
+                attr.fusions[name] = (prev[0] + dur, tuple(refs))
+    return attr
+
+
+_HLO_META_RE = re.compile(r"%([\w.\-]+)\s*=.*?op_name=\"([^\"]+)\"")
+
+
+def hlo_scope_map(hlo_text: str) -> dict[str, str]:
+    """``hlo_op name → metadata op_name`` from compiled HLO text — the join
+    table for backends whose trace events carry raw HLO op names instead of
+    scope paths. Only entries whose op_name contains a scope are kept."""
+    out: dict[str, str] = {}
+    for m in _HLO_META_RE.finditer(hlo_text):
+        op, op_name = m.group(1), m.group(2)
+        if parse_scope(op_name) is not None:
+            out[op] = op_name
+    return out
+
+
+def scope_map_of(jfn: Any, *args, **kwargs) -> dict[str, str]:
+    """Convenience: the :func:`hlo_scope_map` of an already-jitted callable
+    (``jax.jit`` object or ``Compiled``), lowering on ``args`` if needed."""
+    text = None
+    if hasattr(jfn, "as_text"):
+        text = jfn.as_text()
+    elif hasattr(jfn, "lower"):
+        text = jfn.lower(*args, **kwargs).compile().as_text()
+    return hlo_scope_map(text) if text else {}
+
+
+# =============================================================================
+# Roofline/MFU join (predicted × measured)
+# =============================================================================
+
+
+@dataclass
+class JoinedRow:
+    """One trace line with both its measured device time and its static
+    roofline bound."""
+
+    label: str
+    sym: str
+    line: int
+    pass_name: Optional[str]
+    measured_us: float  # per profiled step
+    share: float  # of device-busy time
+    roofline_us: Optional[float] = None
+    efficiency: Optional[float] = None  # roofline/measured, 1.0 = at the roof
+    bound: Optional[str] = None  # compute|memory|comm|free
+    flops: Optional[float] = None
+
+
+@dataclass
+class PerfJoin:
+    """The joined report: top-k measured ops annotated with predicted
+    cost, roofline ratio, and boundedness; plus trace-level rollups."""
+
+    rows: list[JoinedRow]
+    attribution: Attribution
+    cost: Optional[Any] = None  # TraceCost
+    steps: int = 1
+    measured_step_us: float = 0.0
+    mfu: Optional[float] = None
+    padding_waste_elements: Optional[float] = None
+
+    def format(self, top_k: int = 10) -> str:
+        a = self.attribution
+        lines = [
+            f"perf attribution: {self.measured_step_us / 1e3:.3f} ms device-busy/step "
+            f"({self.steps} step(s) profiled), {a.coverage * 100:.1f}% attributed",
+        ]
+        if self.cost is not None:
+            c = self.cost
+            lines.append(
+                f"  cost model [{c.device.name}]: {c.total_flops / 1e9:.2f} GFLOP/step, "
+                f"roofline bound {c.roofline_s * 1e3:.3f} ms"
+                + (f", MFU at measured time {self.mfu * 100:.1f}%" if self.mfu is not None else "")
+            )
+        if self.padding_waste_elements:
+            lines.append(
+                f"  bucket padding waste: {self.padding_waste_elements:.3g} elements "
+                "dispatched beyond true extents (thunder_tpu_padding_waste_elements_total)"
+            )
+        lines.append(
+            f"  {'line':<34} {'us/step':>9} {'share':>7} {'roofline':>9} {'eff':>6} {'bound':>8}"
+        )
+        for r in self.rows[:top_k]:
+            roof = f"{r.roofline_us:.1f}" if r.roofline_us is not None else "-"
+            eff = f"{r.efficiency * 100:.0f}%" if r.efficiency is not None else "-"
+            lines.append(
+                f"  {r.label:<34.34} {r.measured_us:>9.1f} {r.share * 100:>6.1f}% "
+                f"{roof:>9} {eff:>6} {r.bound or '-':>8}"
+            )
+        if a.unattributed:
+            worst = sorted(a.unattributed.items(), key=lambda kv: -kv[1])[:3]
+            lines.append("  unattributed: " + ", ".join(
+                f"{n}={us / self.steps:.0f}us" for n, us in worst))
+        return "\n".join(lines)
+
+    def __str__(self) -> str:
+        return self.format()
+
+
+def join_cost_attribution(
+    attr: Attribution,
+    cost: Optional[Any] = None,
+    *,
+    steps: int = 1,
+) -> PerfJoin:
+    """Join measured per-line device time with the static cost model.
+
+    Lines match on (index, symbol) against ``cost`` rows (both derive from
+    the same execution trace when ``cost`` came from
+    ``trace_cost(compile_stats(jfn).last_traces[-1])``); a line that moved
+    between passes falls back to a symbol-name match. ``steps`` divides
+    measured totals down to per-step numbers comparable with the per-call
+    roofline bounds."""
+    steps = max(1, steps)
+    cost_by_line: dict[tuple[int, str], Any] = {}
+    cost_by_sym: dict[str, list] = {}
+    if cost is not None:
+        for r in cost.rows:
+            cost_by_line[(r.index, r.sym)] = r
+            cost_by_sym.setdefault(r.sym, []).append(r)
+
+    rows: list[JoinedRow] = []
+    for ref, us in sorted(attr.by_line.items(), key=lambda kv: -kv[1]):
+        measured = us / steps
+        row = JoinedRow(
+            label=ref.label, sym=ref.sym, line=ref.line, pass_name=ref.pass_name,
+            measured_us=measured,
+            share=us / attr.device_busy_us if attr.device_busy_us else 0.0,
+        )
+        crow = cost_by_line.get((ref.line, ref.sym))
+        if crow is None and len(cost_by_sym.get(ref.sym, [])) == 1:
+            crow = cost_by_sym[ref.sym][0]
+        if crow is not None:
+            row.roofline_us = crow.roofline_s * 1e6
+            row.bound = crow.bound
+            row.flops = crow.flops
+            if measured > 0:
+                row.efficiency = min(1.0, row.roofline_us / measured)
+        rows.append(row)
+
+    join = PerfJoin(
+        rows=rows, attribution=attr, cost=cost, steps=steps,
+        measured_step_us=attr.device_busy_us / steps,
+    )
+    if cost is not None and attr.device_busy_us:
+        join.mfu = cost.mfu_at(attr.device_busy_us / steps / 1e6)
+    try:
+        from thunder_tpu.observability import metrics as obsm
+
+        if obsm.enabled():
+            waste = obsm.PADDING_WASTE_ELEMENTS.value()
+            if waste:
+                join.padding_waste_elements = float(waste)
+    except Exception:
+        pass
+    return join

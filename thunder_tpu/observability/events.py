@@ -15,7 +15,11 @@ Activation:
 Schema (stable; the replay tool validates it):
 
     {"v": 1, "ts": <unix seconds>, "seq": <per-log counter>, "kind": "...",
+     "pid": <os pid>, "host": <jax.process_index() or 0>,
      ...kind-specific fields...}
+
+``pid``/``host`` identify the writer so per-host logs of a multi-host job
+merge deterministically (``scripts/lint_traces.py --events h0.jsonl h1.jsonl``).
 
 Kind-specific required fields live in ``thunder_tpu.analysis.events.SCHEMA``.
 Emission is a no-op costing one dict lookup when no log is active.
@@ -27,11 +31,45 @@ import contextlib
 import contextvars
 import json
 import os
+import sys
 import threading
 import time
 from typing import Any, Optional
 
 SCHEMA_VERSION = 1
+
+
+_identity: dict[str, Any] = {}
+
+
+def host_identity() -> dict[str, Any]:
+    """``{"pid", "host"}`` stamped into every event record so per-host JSONL
+    logs from a multi-host job can be merged with stable ordering
+    (``thunder_tpu.analysis.events.merge_event_logs``). ``host`` is
+    ``jax.process_index()`` when the jax backend is already up at the FIRST
+    emission, else 0 — and then FROZEN: merge ordering and compile-id
+    correlation key on (host, pid), so one process's events must never flip
+    identity mid-log (pid disambiguates processes even when several froze
+    host=0). Observability must also never be the thing that initializes
+    the backend, hence asking only an existing one."""
+    pid = os.getpid()
+    if _identity.get("pid") != pid:
+        # Fork-safety: a forked worker is a new writer and re-resolves.
+        _identity.clear()
+        _identity["pid"] = pid
+        host = 0
+        jax_mod = sys.modules.get("jax")
+        if jax_mod is not None:
+            try:
+                # Only ask an already-initialized backend; process_index()
+                # on a cold jax would trigger backend init from inside an
+                # emit() call.
+                if jax_mod._src.xla_bridge._backends:  # type: ignore[attr-defined]
+                    host = int(jax_mod.process_index())
+            except Exception:
+                pass
+        _identity["host"] = host
+    return {"pid": pid, "host": _identity["host"]}
 
 
 class EventLog:
@@ -56,6 +94,7 @@ class EventLog:
         if self._dead:
             return
         rec = {"v": SCHEMA_VERSION, "ts": time.time(), "kind": kind}
+        rec.update(host_identity())
         rec.update(fields)
         try:
             with self._lock:

@@ -296,6 +296,15 @@ RECOMPILES = REGISTRY.counter(
 COMPILE_MS = REGISTRY.histogram(
     "thunder_tpu_compile_ms", "End-to-end compile time per entry (ms)"
 )
+# The metric that doubled r4→r5 without anyone noticing: the TOTAL seconds a
+# compile class spends in XLA (staging + backend compile), not just the
+# trace-side per-pass ms. Labelled cls=exact|bucketed (dispatch first runs) or
+# cls=bench_forward|bench_train_step (bench.py's measured compiles).
+XLA_COMPILE_S = REGISTRY.histogram(
+    "thunder_tpu_xla_compile_s",
+    "End-to-end XLA compile+first-run seconds, labelled by compile class",
+    buckets=(0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0),
+)
 PASS_MS = REGISTRY.histogram(
     "thunder_tpu_pass_ms", "Per-transform-pass duration (ms), labelled by pass"
 )

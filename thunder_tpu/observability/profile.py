@@ -44,10 +44,17 @@ def profile(
     """Bracket ``steps`` calls of ``fn(*args, **kwargs)`` with jax profiler
     markers and write an xprof-ready trace directory.
 
-    Returns ``{"trace_dir", "steps", "avg_s", "total_s", "profiler"}`` —
-    ``profiler`` is False when the backend has no profiler plugin and only
-    wall-clock numbers were collected. Parse per-HLO-op self times with
-    xprof (``hlo_stats``) over ``trace_dir``; see docs/observability.md.
+    Returns ``{"trace_dir", "steps", "avg_s", "total_s", "profiler",
+    "attribution"}`` — ``profiler`` is False when the backend has no
+    profiler plugin and only wall-clock numbers were collected.
+
+    ``attribution`` closes the loop in-process: when the profiler ran and
+    the trace-events carry annotated-codegen scopes (run under
+    ``THUNDER_TPU_ANNOTATE_TRACES=1``), it is an
+    :class:`~thunder_tpu.observability.attribution.Attribution` mapping
+    measured device time back to trace lines (None otherwise). Join it with
+    the static cost model via ``thunder_tpu.monitor.attribution_report`` or
+    ``scripts/perf_report.py --trace-dir``; see docs/performance.md.
     """
     import jax
 
@@ -104,4 +111,16 @@ def profile(
         "profiler": profiler_ok,
     }
     emit_event("profile_stop", **result)
+    # Best-effort in-process attribution (never fails the profile): only
+    # meaningful when annotated codegen stamped scopes into HLO metadata.
+    result["attribution"] = None
+    if profiler_ok:
+        try:
+            from thunder_tpu.observability.attribution import attribute
+
+            attr = attribute(trace_dir)
+            if attr.by_line:
+                result["attribution"] = attr
+        except Exception:
+            pass
     return result
