@@ -24,6 +24,12 @@ Usage:
         # jit(events=...)): validates the JSONL schema and flags recompile
         # storms; several per-host logs are merged with stable ordering
         # (thunder_tpu.analysis.events; docs/observability.md)
+    python scripts/lint_traces.py --chaos
+        # resilience smoke (docs/robustness.md): run the GPT gradient
+        # pipeline under a canned fault schedule (kernel raise, compile
+        # failure, OOM, NaN poison) and fail on any unrecovered fault,
+        # non-baseline-equal recovery, or missing degradation event in the
+        # JSONL log (replayed through the correlation rule)
 """
 
 from __future__ import annotations
@@ -131,11 +137,125 @@ def _bench_history_gate() -> int:
     return run_history_gate(paths, gate=True)
 
 
-_USAGE = "usage: lint_traces.py [pattern] | --events <log.jsonl> [...] [--storm-threshold N]"
+def _chaos_smoke() -> int:
+    """--chaos: the resilience smoke (ISSUE 6 satellite). Runs the GPT
+    gradient pipeline under a canned fault schedule — executor kernel raise,
+    XLA compile failure, device OOM, NaN poisoning — asserting every fault
+    recovers to the un-faulted baseline (bitwise) or raises the typed error
+    naming its seam, and that the JSONL log carries the correlated
+    ``fault_injected`` → degradation event pair for each injection (the
+    replay's ``events.unrecovered-fault`` rule). Returns the error count."""
+    import tempfile
+
+    os.environ.setdefault("THUNDER_TPU_RETRY_BACKOFF_S", "0")
+
+    import numpy as np
+    import thunder_tpu as ttpu
+    from thunder_tpu.analysis import Severity
+    from thunder_tpu.analysis.events import format_replay, replay_events
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.extend import OperatorExecutor, get_executor, register_executor
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.resilience import NonFiniteOutputError, chaos, demotion
+
+    demotion.clear_quarantine()
+    rng = np.random.RandomState(0)
+    cfg = m.name_to_config("gpt-tiny")
+    params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+    idx = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+    loss = lambda p, i, t: m.loss_fn(p, i, t, cfg)  # noqa: E731
+
+    log = os.path.join(tempfile.mkdtemp(prefix="ttpu_chaos_"), "events.jsonl")
+    n_errors = 0
+
+    def flat(out):
+        from thunder_tpu.core.pytree import tree_flatten
+
+        return [np.asarray(x) for x in tree_flatten(out)[0]]
+
+    print("--- chaos smoke: un-faulted baseline")
+    baseline = flat(ttpu.value_and_grad(loss, executors=["jax"])(params, idx, tgt))
+
+    # A chaos-armed smoke executor claiming the erf prim (inside the GPT
+    # MLP's gelu): the kernel-raise seam for an environment with no TPU
+    # kernels (pallasex/flashex carry the same seam on real hardware). The
+    # impl delegates to the jax executor's, so even an un-demoted claim is
+    # bitwise-identical to the baseline.
+    from thunder_tpu.core.prims import PrimIDs
+
+    smoke_ex = get_executor("chaos_smoke")
+    if smoke_ex is None:
+        smoke_ex = OperatorExecutor("chaos_smoke")
+        register_executor(smoke_ex)
+        _jax_erf = get_executor("jax").get_impl(PrimIDs.ERF)
+
+        def _smoke_erf(a, _jax_erf=_jax_erf):
+            chaos.kernel_seam("chaos_smoke", "erf")
+            return _jax_erf(a)
+
+        smoke_ex.register_implementation(PrimIDs.ERF, fn=_smoke_erf)
+
+    schedules = [
+        ("kernel_raise (executor demotion)", ["chaos_smoke", "jax"],
+         "kernel_raise@chaos_smoke*1", None),
+        ("compile_fail + oom (de-opt ladder)", ["jax"], "compile_fail*1;oom*1", None),
+        ("nan poison (isfinite guard)", ["jax"], "nan@matmul*1", "rerun-instrumented"),
+    ]
+    for name, executors, spec, on_nan in schedules:
+        print(f"--- chaos smoke: {name} [{spec}]")
+        jf = ttpu.value_and_grad(
+            loss, executors=executors, events=log, chaos=spec, on_nan=on_nan
+        )
+        try:
+            out = flat(jf(params, idx, tgt))
+        except NonFiniteOutputError as e:
+            if on_nan is None:
+                n_errors += 1
+                print(f"    FAILED: unexpected NonFiniteOutputError: {e}")
+            else:
+                print(f"    recovered loudly: {type(e).__name__} "
+                      f"attributed to {e.symbol!r}")
+            continue
+        except Exception as e:  # an unrecovered fault escaped: that IS the failure
+            n_errors += 1
+            print(f"    FAILED (unrecovered fault): {type(e).__name__}: {e}")
+            continue
+        if on_nan is not None:
+            n_errors += 1
+            print("    FAILED: nan poison did not trip the isfinite guard")
+        elif len(out) != len(baseline) or any(
+            not np.array_equal(a, b) for a, b in zip(out, baseline)
+        ):
+            n_errors += 1
+            print("    FAILED: recovered run is not bitwise-equal to baseline")
+        else:
+            print("    recovered, bitwise-equal to baseline")
+
+    print("--- chaos smoke: event-log replay (correlation rule)")
+    # Recompiles ARE the recovery mechanism under chaos (every demotion and
+    # de-opt recompiles), so the storm heuristic gets headroom here; the
+    # correlation rule is what this replay is for.
+    summary, diags = replay_events(log, storm_threshold=16)
+    print(format_replay(summary, diags))
+    n_errors += sum(1 for d in diags if d.severity >= Severity.ERROR)
+    if not summary.get("faults_injected"):
+        n_errors += 1
+        print("    FAILED: no fault_injected events recorded")
+    demotion.clear_quarantine()
+    print(f"\nlint_traces --chaos: {n_errors} error(s)")
+    return n_errors
+
+
+_USAGE = ("usage: lint_traces.py [pattern] | --chaos | "
+          "--events <log.jsonl> [...] [--storm-threshold N]")
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+
+    if "--chaos" in argv:
+        return 1 if _chaos_smoke() else 0
 
     if "--events" in argv:
         i = argv.index("--events")

@@ -40,6 +40,7 @@ from thunder_tpu.common import (  # noqa: F401
     ThunderSharpEdgeWarning,
 )
 from thunder_tpu import monitor  # noqa: F401  # metrics facade (docs/observability.md)
+from thunder_tpu import resilience  # noqa: F401  # fault injection + recovery (docs/robustness.md)
 from thunder_tpu.observability.profile import profile  # noqa: F401
 
 # Legacy entry point (reference parity: thunder.compile, thunder/__init__.py:655
@@ -54,6 +55,6 @@ __all__ = [
     "cache_misses", "cache_info", "set_execution_callback_file",
     "CACHE_OPTIONS", "SHARP_EDGES_OPTIONS",
     "ThunderSharpEdgeError", "ThunderSharpEdgeWarning",
-    "dtypes", "devices", "monitor", "profile",
+    "dtypes", "devices", "monitor", "profile", "resilience",
 ]
 

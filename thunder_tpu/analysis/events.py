@@ -38,8 +38,32 @@ SCHEMA: dict[str, frozenset] = {
     "nan_watch": frozenset({"value_kind", "symbol", "bsym_index", "line", "provenance"}),
     "profile_start": frozenset({"dir", "steps"}),
     "profile_stop": frozenset({"steps", "total_s", "avg_s", "profiler"}),
+    # Resilience subsystem (thunder_tpu/resilience; docs/robustness.md).
+    "fault_injected": frozenset({"seam", "target", "n"}),
+    "executor_demoted": frozenset({"sym", "executor", "ttl_s", "reason"}),
+    "compile_deopt": frozenset({"level", "action", "reason", "attempt"}),
+    "nan_guard": frozenset({"action"}),
+    "checkpoint_save": frozenset({"path", "step", "ok", "attempt"}),
+    "checkpoint_restore": frozenset({"path", "step", "ok"}),
+    "preemption": frozenset({"signal", "step"}),
+    "cache_repair": frozenset({"action", "path", "reason"}),
 }
 _COMMON = frozenset({"v", "ts", "seq", "kind"})
+
+# Chaos correlation contract (ISSUE 6 acceptance): every injected fault must
+# be followed by its recovery/degradation event — seams mapped to the kinds
+# that prove the runtime degraded instead of dying. Seams absent here
+# (straggler) recover by simply completing.
+FAULT_RECOVERY_KINDS: dict[str, frozenset] = {
+    "kernel_raise": frozenset({"executor_demoted"}),
+    "compile_fail": frozenset({"compile_deopt", "executor_demoted"}),
+    "compile_timeout": frozenset({"compile_deopt"}),
+    "oom": frozenset({"compile_deopt"}),
+    "nan": frozenset({"nan_guard"}),
+    "ckpt_io": frozenset({"checkpoint_save"}),
+    "preempt": frozenset({"checkpoint_save"}),
+    "cache_corrupt": frozenset({"cache_repair"}),
+}
 
 
 def _parse_log_lines(path: str, diags: list[Diagnostic]) -> list[tuple[int, dict]]:
@@ -126,6 +150,8 @@ def replay_events(
     bucket_compile_counts: dict[tuple, int] = {}  # (fn, bucket desc) -> compiles
     buckets: list[str] = []
     sharp_edges: list[str] = []
+    fault_events: list[tuple[int, str, dict]] = []  # (lineno, seam, record)
+    recovery_positions: dict[str, list[int]] = {}  # recovery kind -> linenos
     n_lines = 0
 
     merged = isinstance(path, (list, tuple)) and len(path) != 1
@@ -212,6 +238,14 @@ def replay_events(
                 bucket_by_cid[(*_writer(rec), rec["compile_id"])] = str(rec["buckets"])
             elif kind == "sharp_edge":
                 sharp_edges.append(str(rec["message"]))
+            elif kind == "fault_injected":
+                fault_events.append((lineno, str(rec["seam"]), rec))
+            elif kind in ("executor_demoted", "compile_deopt", "nan_guard",
+                          "cache_repair"):
+                recovery_positions.setdefault(kind, []).append(lineno)
+            elif kind == "checkpoint_save":
+                if rec.get("ok"):
+                    recovery_positions.setdefault(kind, []).append(lineno)
 
     for fn, n in sorted(exact_compiles_by_fn.items()):
         if n > storm_threshold:
@@ -256,6 +290,30 @@ def replay_events(
             rule="events.unclosed-compile", severity=Severity.WARNING,
             message=f"compile {cid[-1]} of {fn!r} has no compile_end (crashed mid-compile?)",
         ))
+    # Chaos correlation: every injected fault with a declared recovery
+    # contract (FAULT_RECOVERY_KINDS) must be followed by its degradation/
+    # recovery event — a fault_injected with no later recovery record means
+    # the runtime died or the recovery path silently skipped its event.
+    unrecovered: list[str] = []
+    for lineno, seam, rec in fault_events:
+        expected = FAULT_RECOVERY_KINDS.get(seam)
+        if not expected:
+            continue
+        if not any(
+            pos > lineno for k in expected for pos in recovery_positions.get(k, [])
+        ):
+            unrecovered.append(f"{seam}@{rec.get('target')}")
+            diags.append(Diagnostic(
+                rule="events.unrecovered-fault", severity=Severity.ERROR,
+                message=(
+                    f"line {lineno}: fault_injected seam={seam!r} "
+                    f"target={rec.get('target')!r} has no subsequent "
+                    f"{'/'.join(sorted(expected))} event — the fault was not "
+                    f"recovered (or the recovery path lost its event)"
+                ),
+                hint="docs/robustness.md lists the expected recovery event "
+                     "per seam",
+            ))
 
     summary = {
         "path": src,
@@ -269,6 +327,8 @@ def replay_events(
         "pass_ms_total": {k: round(v, 3) for k, v in sorted(pass_ms.items())},
         "bucket_selects": buckets,
         "sharp_edges": sharp_edges,
+        "faults_injected": [f"{seam}@{rec.get('target')}" for _, seam, rec in fault_events],
+        "unrecovered_faults": unrecovered,
     }
     return summary, diags
 
@@ -291,6 +351,12 @@ def format_replay(summary: dict, diags: list[Diagnostic]) -> str:
         lines.append(f"  bucket selects: {len(summary['bucket_selects'])}")
     if summary["sharp_edges"]:
         lines.append(f"  sharp edges: {len(summary['sharp_edges'])}")
+    if summary.get("faults_injected"):
+        lines.append(
+            f"  faults injected: {len(summary['faults_injected'])} "
+            f"({', '.join(summary['faults_injected'])}); "
+            f"unrecovered: {len(summary.get('unrecovered_faults') or [])}"
+        )
     for d in diags:
         lines.append("  " + d.format().replace("\n", "\n  "))
     return "\n".join(lines)

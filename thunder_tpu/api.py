@@ -54,6 +54,8 @@ from thunder_tpu.executors.passes import del_last_used, transform_for_execution
 from thunder_tpu.extend import resolve_executors
 from thunder_tpu.observability import events as obs_events
 from thunder_tpu.observability import metrics as obsm
+from thunder_tpu.resilience import chaos as chaos_mod
+from thunder_tpu.resilience import deopt as deopt_mod
 from thunder_tpu.transforms.common import cse, dce
 from thunder_tpu.transforms.rng import RNG_TAG, functionalize_rng_ops
 
@@ -461,7 +463,10 @@ def _compile_entry(cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict)
             cache_option=cd.cache_option.name.lower(),
             call=cs.calls,
         )
-        if cd.cache_option is CACHE_OPTIONS.SYMBOLIC_VALUES:
+        # De-opt ladder L3 (resilience/deopt.py): exact shapes — no bucket
+        # padding — shrinks the entry's live memory after repeated OOMs.
+        if (cd.cache_option is CACHE_OPTIONS.SYMBOLIC_VALUES
+                and deopt_mod.current_level(cd) < 3):
             sym_spec = _symbolic_spec_for_call(cd, cs, args, kwargs)
             if sym_spec is not None:
                 events.emit_event(
@@ -479,12 +484,33 @@ def _compile_entry_checked(
     cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict, sym_spec,
     compile_id: Optional[int] = None,
 ) -> CacheEntry:
+    # De-opt ladder position (resilience/deopt.py): 0 = normal; ≥1 disables
+    # fusion passes + buffer donation; ≥2 compiles under aggressive
+    # rematerialization (scoped HERE so an aborted compile can't leak the
+    # contextvar); ≥3 was applied upstream (exact shapes).
+    deopt_level = deopt_mod.current_level(cd)
+    if deopt_level >= 2:
+        from thunder_tpu.transforms.rematerialization import aggressive_remat
+
+        with aggressive_remat():
+            return _compile_entry_impl(cd, cs, args, kwargs, sym_spec,
+                                       compile_id, deopt_level)
+    return _compile_entry_impl(cd, cs, args, kwargs, sym_spec, compile_id, deopt_level)
+
+
+def _compile_entry_impl(
+    cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict, sym_spec,
+    compile_id: Optional[int], deopt_level: int,
+) -> CacheEntry:
     import jax
 
     from thunder_tpu.core.trace import mark
 
     build_start = timer_ns()
     cs.compile_count += 1
+    # Chaos seam: injected XLA compile failure/timeout — lands on the same
+    # recovery path (the de-opt ladder) as the real thing.
+    chaos_mod.compile_seam(getattr(cd.fn, "__name__", repr(cd.fn)))
     cs.last_trace_tracing_start = timer_ns()
     with sharp_edges_policy(cd.sharp_edges):
         plg_trc, comp_trc = trace_program(
@@ -548,9 +574,11 @@ def _compile_entry_checked(
     # Joint-trace attention-residual saving: when grad produced fw+bw in one
     # trace, let the flash backward consume saved (out, lse) instead of
     # recomputing the forward kernel (transforms/attention_residuals.py).
-    from thunder_tpu.transforms.attention_residuals import save_sdpa_residuals_joint
+    # Skipped at de-opt ladder level ≥ 1 ("disable fusion").
+    if deopt_level < 1:
+        from thunder_tpu.transforms.attention_residuals import save_sdpa_residuals_joint
 
-    comp_trc = save_sdpa_residuals_joint(comp_trc, cd.executors_list)
+        comp_trc = save_sdpa_residuals_joint(comp_trc, cd.executors_list)
 
     comp_trc = functionalize_rng_ops(comp_trc)
     if comp_trc.tags.get(RNG_TAG):
@@ -558,6 +586,17 @@ def _compile_entry_checked(
 
     extrace = transform_for_execution(comp_trc, cd.executors_list)
     computation_traces.append(extrace)
+
+    # Chaos seam: NaN-poison a chosen BoundSymbol (after claiming, so the
+    # poison survives into both the staged entry and the instrumented
+    # attribution re-run the on_nan guard performs).
+    poisoned = chaos_mod.maybe_poison_nan(extrace)
+    if poisoned is not extrace:
+        extrace = poisoned
+        computation_traces.append(extrace)
+    # The claimed (pre-instrumentation, pre-del) trace: what the on_nan
+    # guard re-runs under a NaN watcher to attribute a non-finite step.
+    claimed_extrace = extrace
 
     # Per-op instrumentation (observability/instrument.py): bracket every
     # value-producing bsym with host pre/post hooks. Runs after claiming (so
@@ -612,14 +651,22 @@ def _compile_entry_checked(
         computation_fn = trace_callable
     elif sym_spec is not None:
         # Bucketed staging: padded input buffers are dispatch-owned
-        # temporaries, donated to XLA off-CPU (executors/jaxex.py).
-        computation_fn = jaxex.stage_bucketed(trace_callable, sorted(sym_spec.marks))
+        # temporaries, donated to XLA off-CPU (executors/jaxex.py) — unless
+        # the de-opt ladder disabled donation (level ≥ 1), or the on_nan
+        # guard may re-run these exact buffers through the instrumented
+        # trace (donated arrays are deleted after the staged run).
+        computation_fn = jaxex.stage_bucketed(
+            trace_callable, sorted(sym_spec.marks),
+            donate=deopt_level < 1
+            and cd.compile_options.get("on_nan") != "rerun-instrumented",
+        )
     else:
         computation_fn = jax.jit(trace_callable)
 
     torch_facing = any(bridge.is_torch_tensor(x) for x in tree_flatten((args, kwargs))[0])
 
     flat_call, call_treedef = tree_flatten((args, kwargs))
+    on_nan = cd.compile_options.get("on_nan")
     entry = CacheEntry(
         prologue_fn=prologue_fn,
         computation_fn=computation_fn,
@@ -634,8 +681,11 @@ def _compile_entry_checked(
         sym_spec=sym_spec,
         treedef=call_treedef,
         leaf_meta=_leaf_meta(flat_call),
+        on_nan=on_nan,
+        claimed_extrace=claimed_extrace if on_nan else None,
     )
     entry.stats.trace_s = (timer_ns() - build_start) / 1e9
+    entry.stats.degradation_level = deopt_level
     cs.trace_seconds += entry.stats.trace_s
 
     # Observability: compile-side metrics + the compile_end event carrying
@@ -821,9 +871,25 @@ def _run_entry(entry: CacheEntry, flat_inps: tuple, prepared=None) -> Any:
         ]
     if entry.needs_rng:
         inps = inps + [_next_key()]
+    if chaos_mod.enabled():
+        # Chaos seams: injected device OOM (recovered by the de-opt ladder)
+        # and the collective-straggler delay. One contextvar probe when
+        # chaos is inactive.
+        trc = entry.computation_traces[-1] if entry.computation_traces else None
+        chaos_mod.run_seam(
+            has_collectives=bool(
+                trc is not None and int(trc.tags.get("collective_bytes") or 0)
+            )
+        )
     out = entry.computation_fn(*inps)
     if entry.sym_spec is not None:
         out = jaxex.crop_to_extents(out, entry.sym_spec, true_extents)
+    if entry.on_nan is not None and not deopt_mod.outputs_finite(out):
+        # Post-step isfinite guard (jit(on_nan=...)), checked on the CROPPED
+        # output — padding lanes of a bucketed entry may legitimately hold
+        # inf/NaN (e.g. 1/0 on zero-padded rows) that the crop discards.
+        # Attribution re-runs the SAME (padded) inputs instrumented.
+        deopt_mod.handle_nonfinite(entry, inps, entry.on_nan)
     if entry.torch_facing:
         import jax
 
@@ -1019,6 +1085,9 @@ def cache_info(fn: Callable) -> dict:
         "trace_seconds": cs.trace_seconds,
         "first_run_seconds": cs.first_run_seconds,
         "cache_lookup_us_total": cs.cache_lookup_ns / 1e3,
+        # De-opt ladder position new compiles use (per-entry levels are in
+        # each entry's stats below) — resilience/deopt.py.
+        "degradation_level": deopt_mod.current_level(cd) if cd is not None else 0,
         "entries": [
             dict(
                 index=i,
@@ -1073,6 +1142,17 @@ def _ensure_runtime() -> None:
                 _set_unless_user_configured(
                     jax, "jax_persistent_cache_min_entry_size_bytes", 0
                 )
+            if _cache_dir_logged["dir"] != cache_dir:
+                # First sight of this cache dir in the process: the chaos
+                # cache_corrupt seam may truncate an entry here (no-op unless
+                # armed), then the sweep removes corrupted/truncated entries
+                # (torn writes from a crashed or disk-full predecessor) so a
+                # poisoned entry recompiles instead of crashing the load
+                # (resilience/compile_cache.py).
+                from thunder_tpu.resilience.compile_cache import sweep_corrupt_entries
+
+                chaos_mod.corrupt_cache_seam(cache_dir)
+                sweep_corrupt_entries(cache_dir)
             _log_cache_dir_once(cache_dir)
         except Exception:
             pass  # older jax without the persistent-cache config
@@ -1115,6 +1195,8 @@ def jit(
     events: Optional[str] = None,
     debug_watch: Optional[str] = None,
     instrument: Any = None,
+    chaos: Any = None,
+    on_nan: Optional[str] = None,
     **compile_options,
 ) -> Callable:
     """Compile ``fn`` for TPU execution (reference: thunder/__init__.py `jit:299`).
@@ -1151,6 +1233,17 @@ def jit(
       ``InstrumentationHook``, a bare ``fn(rec, outputs)`` callable, or a
       list of those. Instrumented entries run unstaged (op-by-op); with
       neither option the entry stages whole under XLA as usual.
+
+    Resilience (docs/robustness.md):
+
+    - ``chaos`` takes a chaos spec string (or ``ChaosConfig``) activating
+      deterministic fault injection for this function's compiles and runs —
+      the programmatic spelling of ``THUNDER_TPU_CHAOS``;
+    - ``on_nan`` arms a cheap post-step isfinite guard over the outputs:
+      ``"raise"`` raises :class:`~thunder_tpu.resilience.NonFiniteOutputError`,
+      ``"rerun-instrumented"`` first re-runs the failing step once under a
+      NaN watcher so the error names the producing op, ``"warn"`` warns and
+      returns the result.
     """
     if fn is None:
         return functools.partial(
@@ -1163,6 +1256,8 @@ def jit(
             events=events,
             debug_watch=debug_watch,
             instrument=instrument,
+            chaos=chaos,
+            on_nan=on_nan,
             **compile_options,
         )
 
@@ -1189,6 +1284,12 @@ def jit(
             raise NotImplementedError(
                 "debug_watch/instrument are not yet supported on the torch "
                 "nn.Module frontend — jit the functional forward instead"
+            )
+        if chaos is not None or on_nan is not None:
+            raise NotImplementedError(
+                "chaos/on_nan are not yet supported on the torch nn.Module "
+                "frontend — use THUNDER_TPU_CHAOS for process-wide chaos, or "
+                "jit the functional forward instead"
             )
         from thunder_tpu.frontend.module import thunder_module
 
@@ -1219,14 +1320,35 @@ def jit(
         compile_options=dict(
             compile_options, debug_checks=debug_checks,
             debug_watch=debug_watch, instrument=instrument,
+            on_nan=deopt_mod.resolve_on_nan(on_nan),
         ),
     )
+    # Per-function chaos config (resilience/chaos.py): parsed once here,
+    # activated around every dispatch of this function.
+    cd._chaos = chaos_mod.resolve(chaos)
     if events:
         cd._event_log = obs_events.log_for_path(events)
     cs = CompileStats()
 
     @functools.wraps(fn)
     def fn_(*args, **kwargs):
+        log = getattr(cd, "_event_log", None)
+        if cd._chaos is None and log is None:
+            return _dispatch(args, kwargs)
+        import contextlib
+
+        # The function's own event log and chaos config cover the WHOLE
+        # dispatch (not just the compile scope): fault injections, demotions,
+        # and de-opt events fire at run time and must land in the same log
+        # their compile events do.
+        with contextlib.ExitStack() as stack:
+            if log is not None:
+                stack.enter_context(obs_events.event_scope(log))
+            if cd._chaos is not None:
+                stack.enter_context(chaos_mod.chaos_scope(cd._chaos))
+            return _dispatch(args, kwargs)
+
+    def _dispatch(args: tuple, kwargs: dict):
         from thunder_tpu.core.concrete import check_value_guards
 
         cs.calls += 1
@@ -1288,21 +1410,35 @@ def jit(
             entry.stats.hits += 1
             cs.last_trace_cache_stop = timer_ns()
             cs.cache_lookup_ns += cs.last_trace_cache_stop - cs.last_trace_cache_start
-            result = _run_entry(entry, flat_inps, prepared)
-            if entry.epilogue_fn is not None:
-                result = entry.epilogue_fn(args, kwargs, flat_inps, result)
-            cs.last_trace_host_stop = timer_ns()
-            if obsm.enabled():
-                # Single flag check on the warm path when metrics are off
-                # (BENCHMARKS.md budgets: <1% off, <5% on).
-                obsm.CACHE_HITS.inc(kind=hit_kind)
-                obsm.CACHE_LOOKUP_US.observe(
-                    (cs.last_trace_cache_stop - cs.last_trace_cache_start) / 1e3
-                )
-                obsm.DISPATCH_US.observe(
-                    (cs.last_trace_host_stop - cs.last_trace_host_start) / 1e3
-                )
-            return result
+            try:
+                result = _run_entry(entry, flat_inps, prepared)
+            except Exception as e:
+                # Resilience (resilience/deopt.py): a kernel/OOM failure on a
+                # warm entry evicts it, quarantines or de-opts, and falls
+                # through to the recompile path below. Anything unrecognized
+                # propagates untouched.
+                if not deopt_mod.handle_run_failure(e, cd, cs, entry, 0):
+                    raise
+                entry = None
+                # Re-account the call as a miss (it recompiles below), and
+                # don't bill the failed run's wall time as cache-lookup time.
+                cs.cache_hits -= 1
+                cs.last_trace_cache_start = timer_ns()
+            if entry is not None:
+                if entry.epilogue_fn is not None:
+                    result = entry.epilogue_fn(args, kwargs, flat_inps, result)
+                cs.last_trace_host_stop = timer_ns()
+                if obsm.enabled():
+                    # Single flag check on the warm path when metrics are off
+                    # (BENCHMARKS.md budgets: <1% off, <5% on).
+                    obsm.CACHE_HITS.inc(kind=hit_kind)
+                    obsm.CACHE_LOOKUP_US.observe(
+                        (cs.last_trace_cache_stop - cs.last_trace_cache_start) / 1e3
+                    )
+                    obsm.DISPATCH_US.observe(
+                        (cs.last_trace_host_stop - cs.last_trace_host_start) / 1e3
+                    )
+                return result
         cs.last_trace_cache_stop = timer_ns()
         cs.cache_lookup_ns += cs.last_trace_cache_stop - cs.last_trace_cache_start
 
@@ -1314,17 +1450,39 @@ def jit(
             _obs_log.emit(
                 "cache_miss", fn=getattr(cd.fn, "__name__", repr(cd.fn)), call=cs.calls
             )
-        entry = _compile_entry(cd, cs, args, kwargs)
-        if key is not None:
-            if len(cs.fast_cache) > _FAST_CACHE_MAX:
-                cs.fast_cache.clear()
-            cs.fast_cache[key] = entry
-        entry.stats.hits += 1
-        cs.prologue_runs += 1
-        entry.stats.prologue_runs += 1
-        flat_inps = entry.prologue_fn(*args, **kwargs)
-        run_start = timer_ns()
-        result = _run_entry(entry, flat_inps)
+        # Compile + first run under the recovery driver: a failure that
+        # classifies as a kernel fault demotes the claimed executor and
+        # re-claims; a compile failure/OOM climbs the de-opt ladder; both
+        # retry bounded with backoff. Unrecognized failures propagate on the
+        # first throw.
+        attempt = 0
+        while True:
+            try:
+                entry = _compile_entry(cd, cs, args, kwargs)
+            except Exception as e:
+                if deopt_mod.handle_compile_failure(e, cd, cs, attempt):
+                    attempt += 1
+                    continue
+                raise
+            if key is not None:
+                if len(cs.fast_cache) > _FAST_CACHE_MAX:
+                    cs.fast_cache.clear()
+                cs.fast_cache[key] = entry
+            entry.stats.hits += 1
+            cs.prologue_runs += 1
+            entry.stats.prologue_runs += 1
+            flat_inps = entry.prologue_fn(*args, **kwargs)
+            run_start = timer_ns()
+            try:
+                result = _run_entry(entry, flat_inps)
+            except Exception as e:
+                if deopt_mod.handle_run_failure(e, cd, cs, entry, attempt):
+                    if key is not None:
+                        cs.fast_cache.clear()
+                    attempt += 1
+                    continue
+                raise
+            break
         entry.stats.first_run_s = (timer_ns() - run_start) / 1e9
         cs.first_run_seconds += entry.stats.first_run_s
         if obsm.enabled():

@@ -156,7 +156,8 @@ class CompileData:
 class EntryStats:
     """Per-cache-entry counters (ISSUE 2: cache observability)."""
 
-    __slots__ = ("hits", "fast_hits", "prologue_runs", "guard_fails", "trace_s", "first_run_s")
+    __slots__ = ("hits", "fast_hits", "prologue_runs", "guard_fails", "trace_s",
+                 "first_run_s", "degradation_level")
 
     def __init__(self):
         self.hits = 0  # times this entry served a call
@@ -165,6 +166,10 @@ class EntryStats:
         self.guard_fails = 0  # prologue/value-guard rejections during probes
         self.trace_s = 0.0  # host tracing+transform time building this entry
         self.first_run_s = 0.0  # first execution (includes the XLA compile)
+        # De-opt ladder position this entry compiled at (resilience/deopt.py):
+        # 0 normal, 1 no fusion/donation, 2 + aggressive remat, 3 + exact
+        # shapes. Surfaced per entry by thunder_tpu.cache_info.
+        self.degradation_level = 0
 
     def as_dict(self) -> dict:
         return {k: getattr(self, k) for k in self.__slots__}
@@ -195,6 +200,12 @@ class CacheEntry:
     # treedef and per-leaf metadata of the inputs this entry was built from.
     treedef: Any = None
     leaf_meta: tuple = ()
+    # Post-step isfinite guard policy (jit(on_nan=...)): None disables the
+    # check; "rerun-instrumented" re-runs via claimed_extrace — the claimed
+    # (pre-instrumentation, pre-del) execution trace — under a NaN watcher
+    # to attribute the producing op (resilience/deopt.py).
+    on_nan: Any = None
+    claimed_extrace: Any = None
     stats: EntryStats = field(default_factory=EntryStats)
 
 

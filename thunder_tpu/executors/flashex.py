@@ -56,6 +56,7 @@ import numpy as np
 
 from thunder_tpu.core.proxies import TensorProxy, pyval
 from thunder_tpu.extend import OperatorExecutor, add_default_executor, register_executor
+from thunder_tpu.resilience import chaos
 
 ex = OperatorExecutor("flash")
 register_executor(ex)
@@ -458,6 +459,7 @@ def _expand_gqa(k, v, H):
 
 
 def _sdpa_impl(*args, **kwargs):
+    chaos.kernel_seam("flash", "sdpa")
     b = _sdpa_bound(args, kwargs)
     q, k, v = b["query"], b["key"], b["value"]
     H, D = q.shape[-3], q.shape[-1]
@@ -469,6 +471,7 @@ def _sdpa_impl(*args, **kwargs):
 
 
 def _sdpa_bwd_impl(g, query, key, value, attn_mask=None, is_causal=False, scale=None, enable_gqa=False):
+    chaos.kernel_seam("flash", "sdpa_bwd")
     import jax
 
     H, D = query.shape[-3], query.shape[-1]

@@ -537,17 +537,29 @@ _reg(PrimIDs.UNIFORM_PHILOX, _uniform_philox)
 
 
 def _donation_active() -> bool:
+    # Narrow catch (ISSUE 6 satellite): jax raises RuntimeError when no
+    # backend can initialize — the one legitimate "answer conservatively"
+    # case. Anything else (ImportError from a broken install, a TypeError
+    # from an API change) is a real bug and must propagate, not be
+    # swallowed into silently-disabled donation.
     try:
         return jax.default_backend() != "cpu"
-    except Exception:
+    except RuntimeError as e:
+        from thunder_tpu.common import sharp_edge
+
+        sharp_edge(
+            f"jax backend unavailable while resolving donation "
+            f"({type(e).__name__}: {e}); buffer donation disabled"
+        )
         return False
 
 
-def stage_bucketed(trace_callable, donate_leaves: Sequence[int]):
+def stage_bucketed(trace_callable, donate_leaves: Sequence[int], *, donate: bool = True):
     """jax.jit a trace callable whose ``donate_leaves`` argument positions
     receive freshly padded (dispatch-owned) buffers. Donation is skipped on
-    CPU, where jax does not implement it (and would warn per call)."""
-    if _donation_active() and donate_leaves:
+    CPU, where jax does not implement it (and would warn per call), and at
+    de-opt ladder level ≥ 1 (``donate=False`` — resilience/deopt.py)."""
+    if donate and _donation_active() and donate_leaves:
         return jax.jit(trace_callable, donate_argnums=tuple(donate_leaves))
     return jax.jit(trace_callable)
 

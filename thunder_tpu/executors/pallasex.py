@@ -21,6 +21,7 @@ from typing import Optional
 
 from thunder_tpu.core.proxies import TensorProxy, pyval
 from thunder_tpu.extend import OperatorExecutor, add_default_executor, register_executor
+from thunder_tpu.resilience import chaos
 
 ex = OperatorExecutor("pallas")
 register_executor(ex)
@@ -155,6 +156,7 @@ def _lanes(col):
 
 
 def _ce_impl(input, target, weight=None, ignore_index=-100, reduction="mean", label_smoothing=0.0):
+    chaos.kernel_seam("pallas", "cross_entropy")
     import jax.numpy as jnp
 
     N, V = input.shape
@@ -170,6 +172,7 @@ def _ce_impl(input, target, weight=None, ignore_index=-100, reduction="mean", la
 
 
 def _ce_bwd_impl(g, input, target, ignore_index=-100, reduction="mean"):
+    chaos.kernel_seam("pallas", "cross_entropy_bwd")
     import jax.numpy as jnp
 
     N, V = input.shape
@@ -224,6 +227,7 @@ def _rope_kernel(x_ref, cos_ref, sin_ref, out_ref, *, half: int):
 
 
 def _rope_impl(x, cos, sin):
+    chaos.kernel_seam("pallas", "apply_rope")
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -332,6 +336,7 @@ def _norm_bt(n_rows: int, d: int) -> int:
 
 
 def _rms_impl(a, normalized_shape, weight=None, eps=None):
+    chaos.kernel_seam("norm", "rms_norm")
     import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -437,6 +442,7 @@ def _ln_bwd_kernel(g_ref, x_ref, w_ref, dx_ref, dwp_ref, dbp_ref, *, eps: float)
 
 
 def _ln_impl(a, normalized_shape, weight=None, bias=None, eps=1e-5):
+    chaos.kernel_seam("norm", "layer_norm")
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl

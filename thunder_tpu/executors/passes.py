@@ -48,11 +48,18 @@ def transform_for_execution(trace: TraceCtx, executors_list: Sequence[Executor])
     executors_list = tuple(executors_list) + get_always_executors()
     new_bsyms: list[BoundSymbol] = []
 
+    # Executor demotion (resilience/demotion.py): a (sym, executor) pair
+    # quarantined after a kernel failure is skipped here, so the re-claim
+    # walks down the priority list to jaxex/pythonex until the TTL expires.
+    from thunder_tpu.resilience.demotion import is_quarantined
+
     def claim(bsym: BoundSymbol, depth: int = 0) -> None:
         if bsym.sym.id in _PASSTHROUGH_IDS:
             new_bsyms.append(bsym)
             return
         for ex in executors_list:
+            if is_quarantined(bsym.sym.id, ex.name):
+                continue
             if ex.can_execute(bsym):
                 new_bsyms.append(bsym.from_bsym(sym=_claimed(bsym.sym, ex)))
                 return

@@ -31,6 +31,7 @@ from dataclasses import dataclass
 
 from thunder_tpu.core.proxies import TensorProxy
 from thunder_tpu.extend import OperatorExecutor, register_executor
+from thunder_tpu.resilience import chaos
 
 ex = OperatorExecutor("quant")
 register_executor(ex)
@@ -120,6 +121,7 @@ def _quantize_per_channel(w, qmax, per_channel=True):
 
 
 def _quant_linear_impl(a, w, bias=None):
+    chaos.kernel_seam("quant", "linear")
     import jax.numpy as jnp
     from jax import lax
 

@@ -110,6 +110,12 @@ class EventLog:
                 self._seq += 1
         except OSError as e:
             self._dead = True
+            # Silent observability loss must itself be observable: the drop
+            # counter increments past the metrics gate so monitor.report()
+            # shows it even when metrics were never enabled (ISSUE 6).
+            from thunder_tpu.observability import metrics as obsm
+
+            obsm.EVENT_LOG_DROPPED.inc_always()
             import warnings
 
             warnings.warn(

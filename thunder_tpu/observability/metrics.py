@@ -87,6 +87,14 @@ class Counter(_Metric):
         k = tuple(sorted(labels.items())) if labels else ()
         self._values[k] = self._values.get(k, 0) + n
 
+    def inc_always(self, n: float = 1, **labels) -> None:
+        """Increment even with metrics disabled — reserved for counters
+        whose silence would hide a loss of observability itself (e.g. the
+        event-log drop counter): they must appear in ``monitor.report()``
+        unconditionally."""
+        k = tuple(sorted(labels.items())) if labels else ()
+        self._values[k] = self._values.get(k, 0) + n
+
     def value(self, **labels) -> float:
         return self._values.get(_label_key(labels), 0)
 
@@ -334,4 +342,34 @@ INSTRUMENTED_OP_US = REGISTRY.histogram(
 DEVICE_MEM_HIGH_WATER = REGISTRY.gauge(
     "thunder_tpu_device_mem_high_water_bytes",
     "Peak device memory observed by the MemoryHighWater hook",
+)
+
+# -- resilience (thunder_tpu/resilience; docs/robustness.md) -------------------
+
+FAULTS_INJECTED = REGISTRY.counter(
+    "thunder_tpu_faults_injected_total",
+    "Chaos-harness fault injections, labelled by seam",
+)
+EXECUTOR_DEMOTIONS = REGISTRY.counter(
+    "thunder_tpu_executor_demotions_total",
+    "Quarantined (sym, executor) pairs after kernel failures, labelled by executor",
+)
+COMPILE_DEOPTS = REGISTRY.counter(
+    "thunder_tpu_compile_deopts_total",
+    "Compile de-optimization ladder escalations, labelled by level",
+)
+NAN_GUARD_TRIPS = REGISTRY.counter(
+    "thunder_tpu_nan_guard_trips_total",
+    "Post-step isfinite guard trips (jit(on_nan=...))",
+)
+CHECKPOINT_RETRIES = REGISTRY.counter(
+    "thunder_tpu_checkpoint_retries_total",
+    "Checkpoint save attempts retried after transient I/O errors",
+)
+# inc_always: a dropped observability sink must be visible even with the
+# metrics gate off — silent loss of the event log is the failure mode this
+# counter exists to expose (monitor.report() lists it unconditionally).
+EVENT_LOG_DROPPED = REGISTRY.counter(
+    "thunder_tpu_event_log_dropped_total",
+    "Event-log sinks disabled after I/O failure (each loses all later events)",
 )

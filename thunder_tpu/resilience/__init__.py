@@ -1,0 +1,62 @@
+"""Resilient execution runtime (ISSUE 6).
+
+Production jax_graft serving cannot die on the first Pallas kernel raise,
+XLA ``RESOURCE_EXHAUSTED``, NaN step, corrupted cache entry, or host
+preemption — the executor model is an explicitly priority-ordered claim
+list with fallback all the way down (PAPER.md §1), and this package makes
+the runtime actually walk that ladder under fault:
+
+- :mod:`~thunder_tpu.resilience.chaos` — deterministic, seedable fault
+  injection at named seams (``THUNDER_TPU_CHAOS=<spec>`` /
+  ``jit(chaos=...)``), each injection emitting a ``fault_injected`` event;
+- :mod:`~thunder_tpu.resilience.demotion` — the (sym, executor) quarantine
+  registry consulted by the claiming pass, plus failure classification;
+- :mod:`~thunder_tpu.resilience.deopt` — the compile de-optimization
+  ladder (disable fusion/donation → aggressive remat → exact shapes) with
+  bounded retry/backoff, and the post-step isfinite guard;
+- :mod:`~thunder_tpu.resilience.preemption` — SIGTERM-triggered
+  step-boundary checkpointing with retry/backoff, corrupted-checkpoint
+  detection on restore, and the ``resume()`` path;
+- :mod:`~thunder_tpu.resilience.compile_cache` — persistent XLA
+  compilation-cache integrity sweep (corrupted/truncated entries are
+  deleted and recompiled instead of crashing).
+
+See docs/robustness.md for the fault model and the chaos spec grammar.
+"""
+
+from thunder_tpu.resilience.chaos import (  # noqa: F401
+    ChaosConfig,
+    ChaosError,
+    InjectedCheckpointError,
+    InjectedCompileError,
+    InjectedCompileTimeout,
+    InjectedKernelError,
+    InjectedOOMError,
+    chaos_scope,
+    parse_spec,
+)
+from thunder_tpu.resilience.demotion import (  # noqa: F401
+    clear_quarantine,
+    is_quarantined,
+    quarantine,
+    quarantine_snapshot,
+)
+from thunder_tpu.resilience.deopt import NonFiniteOutputError  # noqa: F401
+from thunder_tpu.resilience.preemption import (  # noqa: F401
+    CheckpointManager,
+    CheckpointRestoreError,
+    CheckpointWriteError,
+    PreemptionGuard,
+    resume,
+    run_training,
+)
+
+__all__ = [
+    "ChaosConfig", "ChaosError", "parse_spec", "chaos_scope",
+    "InjectedKernelError", "InjectedCompileError", "InjectedCompileTimeout",
+    "InjectedOOMError", "InjectedCheckpointError",
+    "quarantine", "is_quarantined", "clear_quarantine", "quarantine_snapshot",
+    "NonFiniteOutputError",
+    "PreemptionGuard", "CheckpointManager", "CheckpointWriteError",
+    "CheckpointRestoreError", "resume", "run_training",
+]

@@ -1,0 +1,476 @@
+"""Deterministic fault-injection harness (the chaos half of ISSUE 6).
+
+Faults are injected at **named seams** — fixed points in the runtime where
+production failures actually occur — so every recovery path (executor
+demotion, the compile de-opt ladder, checkpoint retry, preemption sync) can
+be exercised deterministically in CI instead of waiting for a TPU pod to
+misbehave.
+
+Seams and their typed errors:
+
+=================  =====================================================
+``kernel_raise``   claimed executor kernel raises at compile/first run
+                   (:class:`InjectedKernelError`; recovery: demotion)
+``compile_fail``   XLA compile failure (:class:`InjectedCompileError`;
+                   recovery: de-opt ladder)
+``compile_timeout`` XLA compile timeout (:class:`InjectedCompileTimeout`)
+``oom``            device OOM at run (:class:`InjectedOOMError`, message
+                   mimics ``RESOURCE_EXHAUSTED``; recovery: de-opt ladder)
+``nan``            NaN-poisons a chosen BoundSymbol's output (a trace
+                   pass; recovery: post-step isfinite guard + attribution)
+``straggler``      collective straggler — sleeps ``~<delay>`` seconds at
+                   the dispatch seam (recovery: none needed, run completes)
+``ckpt_io``        checkpoint-write I/O error
+                   (:class:`InjectedCheckpointError`; recovery: retry/
+                   backoff in :class:`~.preemption.CheckpointManager`)
+``preempt``        preemption signal at a chosen training step (recovery:
+                   step-boundary checkpoint + resume)
+``cache_corrupt``  truncates a persistent compile-cache entry (recovery:
+                   :mod:`~.compile_cache` sweep)
+=================  =====================================================
+
+Spec grammar (``THUNDER_TPU_CHAOS=<spec>`` or ``jit(chaos=<spec>)``)::
+
+    spec      := component (";" component)*
+    component := "seed=" INT
+               | seam ["@" target] ["*" count] ["%" prob] ["~" delay_s]
+    count     := INT | "inf"          (default 1: fire once, then disarm)
+    prob      := FLOAT in (0, 1]      (default 1.0; drawn from the seeded RNG)
+    delay_s   := FLOAT                (straggler sleep seconds, default 0.01)
+
+``target`` is seam-specific: for ``kernel_raise`` an executor name or
+``executor:op`` substring; for ``nan`` a BoundSymbol-name substring or
+``L<index>``; for ``preempt`` the step number. Examples::
+
+    THUNDER_TPU_CHAOS="kernel_raise@flash*1"
+    THUNDER_TPU_CHAOS="oom*2;seed=7"
+    THUNDER_TPU_CHAOS="nan@tanh;preempt@3"
+
+Every injection emits a ``fault_injected`` JSONL event and increments
+``thunder_tpu_faults_injected_total{seam=...}``. Injection decisions are
+deterministic given the spec (counts + seeded RNG): the same spec replays
+the same fault schedule.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from thunder_tpu.observability import events as obs_events
+from thunder_tpu.observability import metrics as obsm
+
+SEAMS = (
+    "kernel_raise", "compile_fail", "compile_timeout", "oom", "nan",
+    "straggler", "ckpt_io", "preempt", "cache_corrupt",
+)
+
+
+class ChaosError(RuntimeError):
+    """Base of every chaos-injected error. ``seam`` names the injection
+    point so an unrecovered fault fails loudly with its origin."""
+
+    seam = "unknown"
+
+    def __init__(self, msg: str, *, target: Optional[str] = None):
+        self.target = target
+        super().__init__(msg)
+
+
+class InjectedKernelError(ChaosError):
+    """A claimed executor kernel raised (chaos seam ``kernel_raise``)."""
+
+    seam = "kernel_raise"
+
+    def __init__(self, executor: str, op: str):
+        self.executor = executor
+        self.op = op
+        super().__init__(
+            f"chaos[kernel_raise]: injected kernel failure in executor "
+            f"{executor!r} op {op!r}",
+            target=f"{executor}:{op}",
+        )
+
+
+class InjectedCompileError(ChaosError):
+    seam = "compile_fail"
+
+    def __init__(self, fn_name: str = "?"):
+        super().__init__(
+            f"chaos[compile_fail]: injected XLA compile failure for {fn_name!r}",
+            target=fn_name,
+        )
+
+
+class InjectedCompileTimeout(InjectedCompileError):
+    seam = "compile_timeout"
+
+    def __init__(self, fn_name: str = "?"):
+        ChaosError.__init__(
+            self,
+            f"chaos[compile_timeout]: injected XLA compile timeout for {fn_name!r}",
+            target=fn_name,
+        )
+
+
+class InjectedOOMError(ChaosError):
+    seam = "oom"
+
+    def __init__(self):
+        super().__init__(
+            "chaos[oom]: RESOURCE_EXHAUSTED: injected device out-of-memory"
+        )
+
+
+class InjectedCheckpointError(OSError):
+    """Transient checkpoint-write I/O failure (chaos seam ``ckpt_io``).
+    An OSError so the checkpoint retry path treats it like a real disk/
+    network write error."""
+
+    seam = "ckpt_io"
+
+    def __init__(self):
+        super().__init__("chaos[ckpt_io]: injected checkpoint write I/O error")
+
+
+@dataclass
+class FaultRule:
+    """One armed fault: fires up to ``count`` times with probability
+    ``prob`` per opportunity (drawn from the config's seeded RNG)."""
+
+    seam: str
+    target: Optional[str] = None
+    count: float = 1  # float so "inf" parses; compared against fired
+    prob: float = 1.0
+    delay_s: float = 0.01
+    fired: int = 0
+
+    def exhausted(self) -> bool:
+        return self.fired >= self.count
+
+    def matches(self, target: Optional[str]) -> bool:
+        if self.target is None:
+            return True
+        if target is None:
+            return False
+        return self.target in str(target)
+
+
+@dataclass
+class ChaosConfig:
+    """Parsed chaos spec: rules + the seeded RNG driving probability draws."""
+
+    rules: list = field(default_factory=list)
+    seed: int = 0
+
+    def __post_init__(self):
+        self.rng = random.Random(self.seed)
+
+    def rules_for(self, seam: str):
+        return [r for r in self.rules if r.seam == seam]
+
+
+def parse_spec(spec: str) -> ChaosConfig:
+    """Parse the chaos spec grammar (module docstring) into a
+    :class:`ChaosConfig`. Raises ``ValueError`` on unknown seams or
+    malformed components — a chaos run with a typo'd spec must fail loudly,
+    not silently inject nothing."""
+    rules: list[FaultRule] = []
+    seed = 0
+    for comp in str(spec).split(";"):
+        comp = comp.strip()
+        if not comp:
+            continue
+        if comp.startswith("seed="):
+            seed = int(comp[len("seed="):])
+            continue
+        rule = FaultRule(seam="")
+        rest = comp
+        # Peel *count / %prob / ~delay suffixes from the right, in whatever
+        # order they were written.
+        _attr = {"*": "count", "%": "prob", "~": "delay_s"}
+        while True:
+            pos = max(rest.rfind(sep) for sep in _attr)
+            if pos <= 0:
+                break
+            sep = rest[pos]
+            rest, val = rest[:pos], rest[pos + 1:].strip()
+            if sep == "*":
+                rule.count = float("inf") if val == "inf" else int(val)
+            else:
+                setattr(rule, _attr[sep], float(val))
+        if "@" in rest:
+            rest, _, target = rest.partition("@")
+            rule.target = target.strip() or None
+        rule.seam = rest.strip()
+        if rule.seam not in SEAMS:
+            raise ValueError(
+                f"chaos spec: unknown seam {rule.seam!r} in component {comp!r} "
+                f"(known: {', '.join(SEAMS)})"
+            )
+        if not (0.0 < rule.prob <= 1.0):
+            raise ValueError(f"chaos spec: prob must be in (0, 1], got {rule.prob}")
+        rules.append(rule)
+    return ChaosConfig(rules=rules, seed=seed)
+
+
+# -- activation ----------------------------------------------------------------
+
+_scope: contextvars.ContextVar[Optional[ChaosConfig]] = contextvars.ContextVar(
+    "thunder_tpu_chaos", default=None
+)
+_env = {"resolved": False, "config": None}
+
+
+def _env_config() -> Optional[ChaosConfig]:
+    if not _env["resolved"]:
+        spec = os.environ.get("THUNDER_TPU_CHAOS", "").strip()
+        _env["config"] = parse_spec(spec) if spec else None
+        _env["resolved"] = True
+    return _env["config"]
+
+
+def reset_env_config() -> None:
+    """Re-read ``THUNDER_TPU_CHAOS`` on next use (tests)."""
+    _env["resolved"] = False
+    _env["config"] = None
+
+
+def active() -> Optional[ChaosConfig]:
+    cfg = _scope.get()
+    if cfg is not None:
+        return cfg
+    return _env_config()
+
+
+def enabled() -> bool:
+    return active() is not None
+
+
+@contextlib.contextmanager
+def chaos_scope(config):
+    """Activate a chaos config (spec string or :class:`ChaosConfig`) within
+    the scope; ``None`` leaves the ambient config in place."""
+    if config is None:
+        yield None
+        return
+    if isinstance(config, str):
+        config = parse_spec(config)
+    tok = _scope.set(config)
+    try:
+        yield config
+    finally:
+        _scope.reset(tok)
+
+
+def resolve(config) -> Optional[ChaosConfig]:
+    """Normalize a ``jit(chaos=...)`` value (None | spec str | config)."""
+    if config is None or isinstance(config, ChaosConfig):
+        return config
+    return parse_spec(str(config))
+
+
+# -- injection core ------------------------------------------------------------
+
+
+def _should_fire(seam: str, target: Optional[str] = None) -> Optional[FaultRule]:
+    cfg = active()
+    if cfg is None:
+        return None
+    for rule in cfg.rules_for(seam):
+        if rule.exhausted() or not rule.matches(target):
+            continue
+        if rule.prob < 1.0 and cfg.rng.random() >= rule.prob:
+            continue
+        rule.fired += 1
+        _record(rule, target)
+        return rule
+    return None
+
+
+def _record(rule: FaultRule, target: Optional[str]) -> None:
+    if obsm.enabled():
+        obsm.FAULTS_INJECTED.inc(seam=rule.seam)
+    obs_events.emit_event(
+        "fault_injected",
+        seam=rule.seam,
+        target=target if target is not None else rule.target,
+        n=rule.fired,
+    )
+
+
+# -- seams ---------------------------------------------------------------------
+
+
+def kernel_seam(executor: str, op: str) -> None:
+    """Called at the top of kernel-executor impls (pallasex/flashex/
+    quantex): raise :class:`InjectedKernelError` when an armed
+    ``kernel_raise`` rule matches ``executor`` or ``executor:op``."""
+    if active() is None:  # one-None-check fast path: chaos off costs nothing
+        return
+    if _should_fire("kernel_raise", f"{executor}:{op}") is not None:
+        raise InjectedKernelError(executor, op)
+
+
+def compile_seam(fn_name: str) -> None:
+    """Compile-pipeline seam (api._compile_entry_checked): injected compile
+    failure or timeout."""
+    if active() is None:
+        return
+    if _should_fire("compile_timeout", fn_name) is not None:
+        raise InjectedCompileTimeout(fn_name)
+    if _should_fire("compile_fail", fn_name) is not None:
+        raise InjectedCompileError(fn_name)
+
+
+def run_seam(has_collectives: bool = False) -> None:
+    """Dispatch-time seam (api._run_entry): device OOM, and the collective
+    straggler delay (fires on any entry when the rule's target is ``any``,
+    else only on traces containing collectives)."""
+    if active() is None:
+        return
+    if _should_fire("oom") is not None:
+        raise InjectedOOMError()
+    cfg = active()
+    for rule in cfg.rules_for("straggler"):
+        if rule.exhausted():
+            continue
+        if rule.target != "any" and not has_collectives:
+            continue
+        if rule.prob < 1.0 and cfg.rng.random() >= rule.prob:
+            continue
+        rule.fired += 1
+        _record(rule, rule.target)
+        time.sleep(rule.delay_s)
+
+
+def checkpoint_seam() -> None:
+    """Checkpoint-write seam (resilience.preemption.CheckpointManager)."""
+    if active() is None:
+        return
+    if _should_fire("ckpt_io") is not None:
+        raise InjectedCheckpointError()
+
+
+def preempt_at_step(step: int) -> bool:
+    """Training-loop seam: True when an armed ``preempt`` rule targets this
+    step (exact match — ``preempt@3`` must not also fire at step 13) or has
+    no target. The caller treats it exactly like a SIGTERM."""
+    cfg = active()
+    if cfg is None:
+        return False
+    for rule in cfg.rules_for("preempt"):
+        if rule.exhausted():
+            continue
+        if rule.target is not None and rule.target != str(step):
+            continue
+        if rule.prob < 1.0 and cfg.rng.random() >= rule.prob:
+            continue
+        rule.fired += 1
+        _record(rule, str(step))
+        return True
+    return False
+
+
+def corrupt_cache_seam(cache_dir: str) -> Optional[str]:
+    """Truncate one persistent-cache entry to zero bytes (the crash/disk-full
+    corruption mode the sweep repairs). Returns the corrupted path."""
+    if active() is None:
+        return None
+    from thunder_tpu.resilience.compile_cache import _entry_files
+
+    # Check there IS something to corrupt before consuming the rule:
+    # firing (and recording fault_injected) on an empty cache dir would
+    # disarm the rule with no injection and leave an unrecoverable-looking
+    # fault event in the log.
+    entries = _entry_files(cache_dir)
+    if not entries:
+        return None
+    if _should_fire("cache_corrupt", cache_dir) is None:
+        return None
+    victim = entries[0]
+    with open(victim, "w"):
+        pass  # truncate
+    return victim
+
+
+# -- NaN poisoning pass --------------------------------------------------------
+
+
+def _poison_value(x):
+    # Pure function of the tensor: stages fine under jax.jit and runs
+    # eagerly under the instrumented re-run, so attribution lands here.
+    return x * float("nan")
+
+
+def maybe_poison_nan(extrace):
+    """When an armed ``nan`` rule matches a BoundSymbol of ``extrace``
+    (by name substring or ``L<index>``), insert a ``chaos_nan_poison`` op
+    after it and rewrite downstream uses to consume the poisoned value.
+    Runs after claiming so the poison survives into both the staged entry
+    and the instrumented attribution re-run."""
+    cfg = active()
+    if cfg is None or not cfg.rules_for("nan"):
+        return extrace
+
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.core.proxies import TensorProxy, variableify
+    from thunder_tpu.core.pytree import tree_flatten, tree_unflatten
+    from thunder_tpu.core.symbol import Symbol
+    from thunder_tpu.core.trace import from_trace, tracectx, wrap_in_trace_provenance
+
+    target_idx = None
+    target_out = None
+    for i, bsym in enumerate(extrace.bound_symbols):
+        outs = [
+            o for o in bsym.flat_proxy_outs
+            if isinstance(o, TensorProxy) and dtypes.is_inexact_dtype(o.dtype)
+        ]
+        if not outs:
+            continue
+        name_key = f"L{i}"
+        rule = None
+        for r in cfg.rules_for("nan"):
+            if r.exhausted():
+                continue
+            if r.target is None or r.target == name_key or r.target in bsym.sym.name:
+                rule = r
+                break
+        if rule is None:
+            continue
+        rule.fired += 1
+        _record(rule, f"L{i}.{bsym.sym.name}")
+        target_idx, target_out = i, outs[0]
+        break
+    if target_idx is None:
+        return extrace
+
+    start = time.perf_counter_ns()
+    ntrace = from_trace(extrace)
+    with tracectx(ntrace):
+        poisoned = TensorProxy(like=target_out)
+    poison_sym = Symbol(
+        "chaos_nan_poison", meta=None, id="resilience.chaos_nan_poison",
+        is_prim=True, python_impl=_poison_value,
+    )
+    swap = {variableify(target_out): poisoned}
+    new_bsyms = []
+    for i, bsym in enumerate(extrace.bound_symbols):
+        if i <= target_idx:
+            new_bsyms.append(bsym)
+            if i == target_idx:
+                new_bsyms.append(poison_sym.bind(target_out, output=poisoned))
+        else:
+            new_bsyms.append(bsym.from_bsym_swap_proxies(swap, skip_output=True))
+    ntrace.bound_symbols = new_bsyms
+    flat_out, spec = tree_flatten(ntrace.output)
+    ntrace.output = tree_unflatten(
+        spec, [swap.get(variableify(p), p) if isinstance(p, TensorProxy) else p
+               for p in flat_out]
+    )
+    return wrap_in_trace_provenance(ntrace, "Chaos NaN poisoning", start)

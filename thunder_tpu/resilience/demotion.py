@@ -1,0 +1,162 @@
+"""Executor demotion: quarantine failing (sym, executor) pairs and re-claim.
+
+When a claimed executor fails at compile or first run — a Pallas kernel
+raise, a Mosaic lowering error — the runtime must not die: the executor
+model is a priority-ordered claim list with fallback all the way to pure
+Python (PAPER.md §1). This module holds the process-wide **quarantine
+registry**: a ``(sym_id, executor_name) → expiry`` map that the claiming
+pass (executors/passes.py) consults, so a recompile after a failure
+re-claims the quarantined ops further down the priority list
+(``jaxex``/``pythonex``). Entries expire after a TTL
+(``THUNDER_TPU_QUARANTINE_TTL`` seconds, default 300) so a transient
+environment failure doesn't permanently demote a kernel.
+
+Also home to the failure classifier the recovery driver (api.py) uses to
+pick a recovery path: KERNEL → quarantine + re-claim, COMPILE/OOM → the
+de-opt ladder (resilience/deopt.py), everything else → propagate.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from thunder_tpu.observability import events as obs_events
+from thunder_tpu.observability import metrics as obsm
+
+# Executors that are never quarantined: the terminal fallbacks. Demoting the
+# whole ladder would leave nothing to claim with.
+_TERMINAL_EXECUTORS = frozenset({"jax", "python"})
+
+
+def default_ttl() -> float:
+    try:
+        return float(os.environ.get("THUNDER_TPU_QUARANTINE_TTL", "300"))
+    except ValueError:
+        return 300.0
+
+
+_quarantined: dict[tuple, float] = {}  # (sym_id, executor_name) -> expiry
+
+
+def quarantine(sym_id, executor_name: str, *, ttl: Optional[float] = None,
+               reason: str = "runtime failure") -> bool:
+    """Quarantine ``(sym_id, executor_name)`` for ``ttl`` seconds and record
+    the demotion (``executor_demoted`` event +
+    ``thunder_tpu_executor_demotions_total``). Terminal executors are never
+    quarantined (returns False)."""
+    if executor_name in _TERMINAL_EXECUTORS:
+        return False
+    ttl = default_ttl() if ttl is None else float(ttl)
+    _quarantined[(sym_id, executor_name)] = time.monotonic() + ttl
+    if obsm.enabled():
+        obsm.EXECUTOR_DEMOTIONS.inc(executor=executor_name)
+    obs_events.emit_event(
+        "executor_demoted",
+        sym=str(sym_id),
+        executor=executor_name,
+        ttl_s=ttl,
+        reason=reason,
+    )
+    return True
+
+
+def is_quarantined(sym_id, executor_name: str) -> bool:
+    """Claiming-pass check: True while the pair's quarantine is unexpired.
+    A ``("*", executor)`` entry quarantines the whole executor (used when a
+    failure names the executor but the failing op is unknown). Expired
+    entries are purged on probe, re-enabling the executor."""
+    if not _quarantined:
+        return False
+    for key in ((sym_id, executor_name), ("*", executor_name)):
+        expiry = _quarantined.get(key)
+        if expiry is None:
+            continue
+        if time.monotonic() >= expiry:
+            del _quarantined[key]
+            continue
+        return True
+    return False
+
+
+def quarantine_snapshot() -> dict:
+    """{(sym_id, executor): seconds-remaining} for live entries (ops
+    introspection / tests)."""
+    now = time.monotonic()
+    return {k: v - now for k, v in _quarantined.items() if v > now}
+
+
+def clear_quarantine() -> None:
+    _quarantined.clear()
+
+
+# -- failure classification ----------------------------------------------------
+
+KERNEL = "kernel"
+COMPILE = "compile"
+OOM = "oom"
+CACHE_CORRUPT = "cache_corrupt"
+
+_OOM_MARKERS = ("resource_exhausted", "out of memory", "out-of-memory", "oom")
+_KERNEL_MARKERS = ("pallas", "mosaic", "splash")
+_COMPILE_MARKERS = ("xla compilation", "compilation failure", "compile failed",
+                    "internal: during compilation")
+_CACHE_MARKERS = ("persistent cache", "compilation cache", "deserialize")
+
+
+def classify_failure(exc: BaseException) -> Optional[str]:
+    """Map an exception from compile/first-run to a recovery class, or None
+    when it is a genuine user/framework bug that must propagate. Injected
+    chaos errors classify by construction; real errors by the narrow
+    signatures XLA/jaxlib actually produce (RESOURCE_EXHAUSTED, Mosaic/
+    Pallas lowering failures, persistent-cache deserialization)."""
+    from thunder_tpu.resilience.chaos import (
+        InjectedCompileError,
+        InjectedKernelError,
+        InjectedOOMError,
+    )
+
+    if isinstance(exc, InjectedKernelError):
+        return KERNEL
+    if isinstance(exc, InjectedOOMError):
+        return OOM
+    if isinstance(exc, InjectedCompileError):
+        return COMPILE
+    msg = str(exc).lower()
+    type_name = type(exc).__name__
+    if type_name == "XlaRuntimeError" or "jaxlib" in type(exc).__module__:
+        if any(m in msg for m in _OOM_MARKERS):
+            return OOM
+        if any(m in msg for m in _CACHE_MARKERS):
+            return CACHE_CORRUPT
+        if any(m in msg for m in _COMPILE_MARKERS):
+            return COMPILE
+    if any(m in msg for m in _KERNEL_MARKERS):
+        return KERNEL
+    return None
+
+
+def failing_pairs(exc: BaseException, extrace) -> list[tuple]:
+    """The (sym_id, executor_name) pairs to quarantine for a KERNEL-class
+    failure. An injected error names its executor exactly; a real kernel
+    error cannot be attributed to one claimed op from the exception alone,
+    so every non-terminal claim in the failing trace is demoted — strictly
+    safer than dying, and the TTL restores them."""
+    from thunder_tpu.resilience.chaos import InjectedKernelError
+
+    claimed: list[tuple] = []
+    seen = set()
+    for bsym in getattr(extrace, "bound_symbols", ()) or ():
+        ex = bsym.sym.executor
+        if ex is None or ex.name in _TERMINAL_EXECUTORS:
+            continue
+        key = (bsym.sym.id, ex.name)
+        if key not in seen:
+            seen.add(key)
+            claimed.append(key)
+    if isinstance(exc, InjectedKernelError):
+        matched = [k for k in claimed if k[1] == exc.executor]
+        if matched:
+            return matched
+    return claimed

@@ -1,0 +1,265 @@
+"""Compile de-optimization ladder + the recovery driver the dispatcher uses.
+
+On a compile failure or device OOM the runtime does not die — it walks a
+staged de-opt ladder, recompiling with progressively safer (slower,
+smaller-memory) configurations, with bounded retries and exponential
+backoff:
+
+====  ==========================================================
+L0    normal compilation
+L1    disable fusion passes and XLA buffer donation
+L2    L1 + aggressive rematerialization (transforms/rematerialization
+      recomputes longer chains regardless of saved-byte accounting)
+L3    L2 + exact shapes (no bucket padding; shrinks live memory for
+      symbolic-values entries)
+====  ==========================================================
+
+The per-function ladder position is sticky on ``CompileData`` (a function
+that OOMs at L0 compiles at L1 from then on; the TTL story for climbing
+back up is future work) and each entry records the level it was compiled
+at — surfaced as ``degradation_level`` in ``thunder_tpu.cache_info``.
+
+Also here: the cheap post-step isfinite guard (``jit(on_nan=...)``) —
+on a non-finite output the failing step is re-run once **instrumented**
+under a NaN watcher so the producing op is attributed before raising
+(:class:`NonFiniteOutputError`) or warning.
+
+Knobs: ``THUNDER_TPU_MAX_RECOVERY_ATTEMPTS`` (default 4),
+``THUNDER_TPU_RETRY_BACKOFF_S`` (base, default 0.05; doubles per attempt,
+capped at 2s — set 0 in tests).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from thunder_tpu.observability import events as obs_events
+from thunder_tpu.observability import metrics as obsm
+from thunder_tpu.resilience import demotion
+
+MAX_LEVEL = 3
+
+_LEVEL_ACTIONS = {
+    1: "disable fusion/donation",
+    2: "aggressive rematerialization",
+    3: "exact shapes (no bucket padding)",
+}
+
+
+def max_attempts() -> int:
+    try:
+        return int(os.environ.get("THUNDER_TPU_MAX_RECOVERY_ATTEMPTS", "4"))
+    except ValueError:
+        return 4
+
+
+def _backoff_s(attempt: int) -> float:
+    try:
+        base = float(os.environ.get("THUNDER_TPU_RETRY_BACKOFF_S", "0.05"))
+    except ValueError:
+        base = 0.05
+    return min(base * (2 ** attempt), 2.0)
+
+
+def current_level(cd) -> int:
+    return getattr(cd, "_deopt_level", 0)
+
+
+def escalate(cd, reason: str, attempt: int) -> bool:
+    """Bump ``cd``'s ladder position (bounded), record it, and sleep the
+    backoff. False when the ladder is exhausted — the caller re-raises."""
+    level = current_level(cd) + 1
+    if level > MAX_LEVEL or attempt >= max_attempts():
+        return False
+    cd._deopt_level = level
+    backoff = _backoff_s(attempt)
+    if obsm.enabled():
+        obsm.COMPILE_DEOPTS.inc(level=str(level))
+    obs_events.emit_event(
+        "compile_deopt",
+        level=level,
+        action=_LEVEL_ACTIONS.get(level, "?"),
+        reason=reason,
+        attempt=attempt,
+        backoff_s=backoff,
+    )
+    if backoff:
+        time.sleep(backoff)
+    return True
+
+
+# -- the recovery driver (called from api.fn_) ---------------------------------
+
+
+def handle_compile_failure(exc: BaseException, cd, cs, attempt: int) -> bool:
+    """Recovery decision for an exception raised while *building* an entry
+    (tracing/claiming/staging). True → the caller retries the compile."""
+    kind = demotion.classify_failure(exc)
+    if kind in (demotion.COMPILE, demotion.OOM):
+        return escalate(cd, f"compile failure: {kind}", attempt)
+    if kind == demotion.KERNEL:
+        # A kernel executor raised while staging its claimed op: demote and
+        # re-claim (no ladder bump needed — the program itself is fine).
+        return _demote_from(exc, None, cs, attempt)
+    if kind == demotion.CACHE_CORRUPT:
+        return _purge_compile_cache(exc, attempt)
+    return False
+
+
+def handle_run_failure(exc: BaseException, cd, cs, entry, attempt: int) -> bool:
+    """Recovery decision for an exception raised while *running* an entry
+    (first run = the real XLA compile; warm run = kernel/device fault).
+    Evicts the entry so the retry recompiles. True → caller retries."""
+    kind = demotion.classify_failure(exc)
+    if kind is None:
+        return False
+    _evict(cs, entry)
+    if kind == demotion.KERNEL:
+        extrace = entry.computation_traces[-1] if entry.computation_traces else None
+        return _demote_from(exc, extrace, cs, attempt)
+    if kind in (demotion.COMPILE, demotion.OOM):
+        return escalate(cd, f"run failure: {kind}", attempt)
+    if kind == demotion.CACHE_CORRUPT:
+        return _purge_compile_cache(exc, attempt)
+    return False
+
+
+def _demote_from(exc, extrace, cs, attempt: int) -> bool:
+    if attempt >= max_attempts():
+        return False
+    pairs = demotion.failing_pairs(exc, extrace) if extrace is not None else []
+    if not pairs:
+        from thunder_tpu.resilience.chaos import InjectedKernelError
+
+        if isinstance(exc, InjectedKernelError):
+            # Staging-time raise: the trace is not in hand, but the injected
+            # error names the executor — quarantine it for every op it could
+            # have claimed by quarantining the (executor-wide) wildcard the
+            # claiming pass also consults.
+            return demotion.quarantine("*", exc.executor, reason=str(exc))
+        return False
+    demoted = False
+    for sym_id, ex_name in pairs:
+        demoted |= demotion.quarantine(sym_id, ex_name, reason=type(exc).__name__)
+    return demoted
+
+
+def _evict(cs, entry) -> None:
+    try:
+        cs.cache_entries.remove(entry)
+    except ValueError:
+        pass
+    cs.fast_cache.clear()  # keys pointing at the dead entry regenerate
+
+
+def _purge_compile_cache(exc, attempt: int) -> bool:
+    if attempt >= max_attempts():
+        return False
+    from thunder_tpu.resilience import compile_cache
+
+    return compile_cache.purge_on_error(exc)
+
+
+# -- post-step isfinite guard --------------------------------------------------
+
+
+class NonFiniteOutputError(RuntimeError):
+    """``jit(on_nan=...)``: a step produced NaN/Inf. When the entry was
+    re-run instrumented, ``symbol``/``line``/``provenance`` attribute the
+    producing op."""
+
+    def __init__(self, msg: str, *, symbol: Optional[str] = None,
+                 line: Optional[str] = None, provenance: Optional[str] = None):
+        self.symbol = symbol
+        self.line = line
+        self.provenance = provenance
+        super().__init__(msg)
+
+
+ON_NAN_MODES = ("raise", "rerun-instrumented", "warn")
+
+
+def resolve_on_nan(value) -> Optional[str]:
+    if value is None:
+        return None
+    value = str(value)
+    if value not in ON_NAN_MODES:
+        raise ValueError(
+            f"on_nan: expected one of {ON_NAN_MODES} or None, got {value!r}"
+        )
+    return value
+
+
+def outputs_finite(out) -> bool:
+    """Cheap isfinite sweep over the float tensor leaves of a step output.
+    The per-leaf reductions are folded into ONE device-side scalar so the
+    common all-finite case pays a single host sync, not one per leaf."""
+    import jax
+    import jax.numpy as jnp
+
+    from thunder_tpu.core.pytree import tree_flatten
+
+    checks = [
+        jnp.isfinite(x).all()
+        for x in tree_flatten(out)[0]
+        if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jnp.floating)
+    ]
+    if not checks:
+        return True
+    if len(checks) == 1:
+        return bool(checks[0])
+    return bool(jnp.all(jnp.stack(checks)))
+
+
+def handle_nonfinite(entry, inps: list, mode: str):
+    """The ``on_nan`` policy after the guard tripped. ``rerun-instrumented``
+    re-runs the SAME inputs once through the claimed trace bracketed with a
+    NaN watcher, so the raise names the producing BoundSymbol, its generated
+    line, and the pass that made it."""
+    if obsm.enabled():
+        obsm.NAN_GUARD_TRIPS.inc()
+    obs_events.emit_event("nan_guard", action=mode)
+
+    symbol = line = provenance = None
+    if mode == "rerun-instrumented" and getattr(entry, "claimed_extrace", None) is not None:
+        from thunder_tpu.executors.passes import del_last_used
+        from thunder_tpu.observability.instrument import (
+            NaNWatchError,
+            NaNWatcher,
+            instrument_for_execution,
+        )
+
+        watcher = NaNWatcher(mode="nan+inf")
+        itrace = instrument_for_execution(entry.claimed_extrace, (watcher,))
+        itrace = del_last_used(itrace)
+        try:
+            itrace.python_callable()(*inps)
+        except NaNWatchError as e:
+            symbol, line, provenance = e.sym_name, e.trace_line, e.provenance
+            obs_events.emit_event(
+                "nan_guard", action="attributed", symbol=symbol, line=line,
+                provenance=provenance,
+            )
+    if mode == "warn":
+        import warnings
+
+        warnings.warn(
+            "thunder_tpu: step produced non-finite outputs (on_nan='warn')",
+            RuntimeWarning, stacklevel=3,
+        )
+        return
+    detail = f" — produced by {symbol!r}: {line} [{provenance}]" if symbol else ""
+    if symbol and getattr(entry, "sym_spec", None) is not None:
+        # The instrumented re-run watches PADDED intermediates; an op whose
+        # padding lanes legitimately produce inf/NaN can be named before
+        # the true (cropped-extent) producer. Say so rather than misdirect.
+        detail += (
+            " (bucketed entry: the named op may be a padding-lane producer "
+            "upstream of the true one)"
+        )
+    raise NonFiniteOutputError(
+        f"step produced non-finite outputs (on_nan={mode!r}){detail}",
+        symbol=symbol, line=line, provenance=provenance,
+    )

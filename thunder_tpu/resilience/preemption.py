@@ -1,0 +1,369 @@
+"""Preemption-safe training: SIGTERM → synced step-boundary checkpoint → resume.
+
+Multi-host TPU training (Gemma-on-TPU, PAPERS.md) assumes hosts get
+preempted: the scheduler sends SIGTERM, every host must agree to stop at
+the SAME step boundary, write one consistent checkpoint (with retry on
+transient I/O errors), and a fresh process must resume from the newest
+*complete* checkpoint — never a torn one.
+
+- :class:`PreemptionGuard` — installs the SIGTERM handler; at each step
+  boundary ``should_checkpoint(step)`` returns the multihost-agreed
+  decision (all-reduce of the local flags; single-process = the local
+  flag). The chaos seam ``preempt@<step>`` feeds the same path.
+- :class:`CheckpointManager` — write-to-tmp → atomic rename → META commit
+  marker, retry/backoff on OSError (``ckpt_io`` chaos seam injects here),
+  corrupted/incomplete detection on restore with fallback to the newest
+  complete step, bounded retention.
+- :func:`resume` / :func:`run_training` — the loop: restore (step, rng,
+  optimizer state), run, checkpoint on preemption or cadence. A resumed
+  run reproduces the uninterrupted loss trajectory bitwise
+  (tests/test_resilience.py proves it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import time
+from typing import Any, Callable, Optional
+
+from thunder_tpu.observability import events as obs_events
+from thunder_tpu.observability import metrics as obsm
+from thunder_tpu.resilience import chaos
+
+
+class CheckpointWriteError(RuntimeError):
+    """Checkpoint save failed after exhausting the retry budget. Names the
+    ``ckpt_io`` seam so chaos runs fail loudly when retries are too few."""
+
+
+class CheckpointRestoreError(RuntimeError):
+    """No complete checkpoint could be restored from the directory."""
+
+
+class Preempted(RuntimeError):
+    """Raised by :func:`run_training` after the preemption checkpoint is
+    durably written — the caller exits; the next process resumes."""
+
+    def __init__(self, step: int, path: str):
+        self.step = step
+        self.path = path
+        super().__init__(f"preempted: checkpoint written at step {step} ({path})")
+
+
+class PreemptionGuard:
+    """SIGTERM-triggered stop flag with multihost agreement.
+
+    Use as a context manager around the training loop; the previous signal
+    handler is restored on exit. ``should_checkpoint(step)`` is called at
+    step boundaries only, so the checkpoint always lands on a consistent
+    state."""
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self._signals = tuple(signals)
+        self._previous: dict = {}
+        self._flag = False
+        self._signum: Optional[int] = None
+        self._reported = False
+
+    def _handler(self, signum, frame) -> None:
+        # Async-signal-safe: ONLY set flags. Emitting an event here could
+        # deadlock — EventLog.emit holds a non-reentrant lock, and the
+        # handler runs on whatever thread was interrupted, possibly inside
+        # that very emit. The event is emitted at the next step-boundary
+        # poll (requested_local), like the chaos preempt path.
+        self._flag = True
+        self._signum = int(signum)
+
+    def install(self) -> "PreemptionGuard":
+        for sig in self._signals:
+            self._previous[sig] = signal.signal(sig, self._handler)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    def requested_local(self, step: Optional[int] = None) -> bool:
+        if self._flag:
+            if not self._reported:
+                self._reported = True
+                obs_events.emit_event(
+                    "preemption", signal=self._signum, step=step
+                )
+            return True
+        if step is not None and chaos.preempt_at_step(step):
+            self._flag = True
+            self._reported = True
+            obs_events.emit_event("preemption", signal=None, step=step)
+            return True
+        return False
+
+    def should_checkpoint(self, step: Optional[int] = None) -> bool:
+        """Multihost-synced stop decision: any host's flag stops every
+        host, so all hosts enter the same collective checkpoint save."""
+        local = self.requested_local(step)
+        try:
+            import jax
+
+            if jax.process_count() > 1:
+                import jax.numpy as jnp
+                from jax.experimental import multihost_utils
+
+                agreed = multihost_utils.process_allgather(
+                    jnp.asarray(1 if local else 0, jnp.int32)
+                )
+                return bool(agreed.max())
+        except Exception:
+            # No initialized distributed backend: the local flag is the truth.
+            pass
+        return local
+
+
+class CheckpointManager:
+    """Durable step checkpoints under ``directory``.
+
+    Layout: ``step_<n>/`` holds the Orbax (or pickle-fallback) state plus a
+    ``META.json`` commit marker written LAST — a directory without META is
+    incomplete (crashed mid-write) and is ignored (and swept) on restore.
+    Saves go to a ``.tmp`` path first and are renamed into place, so a
+    crash can never tear a committed step."""
+
+    META = "META.json"
+
+    def __init__(self, directory: str, *, retries: int = 3,
+                 backoff_s: float = 0.1, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.keep = int(keep)
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- paths ----------------------------------------------------------------
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:08d}")
+
+    def steps_on_disk(self) -> list[int]:
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            if name.startswith("step_") and not name.endswith((".tmp", ".corrupt")):
+                try:
+                    out.append(int(name[len("step_"):]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def _is_complete(self, step: int) -> bool:
+        return os.path.isfile(os.path.join(self._step_dir(step), self.META))
+
+    def latest_complete_step(self) -> Optional[int]:
+        for step in reversed(self.steps_on_disk()):
+            if self._is_complete(step):
+                return step
+        return None
+
+    # -- save -----------------------------------------------------------------
+
+    def save(self, state: Any, step: int, *, rng_seed: Optional[int] = None) -> str:
+        """Write ``state`` for ``step`` with retry/backoff on transient I/O
+        errors. Returns the committed directory path."""
+        final = self._step_dir(step)
+        attempt = 0
+        while True:
+            tmp = final + ".tmp"
+            try:
+                chaos.checkpoint_seam()
+                if os.path.isdir(tmp):
+                    shutil.rmtree(tmp)
+                self._write_state(state, tmp)
+                meta = {
+                    "step": int(step),
+                    "rng_seed": int(rng_seed) if rng_seed is not None else None,
+                    "ts": time.time(),
+                }
+                with open(os.path.join(tmp, self.META), "w") as f:
+                    json.dump(meta, f)
+                if os.path.isdir(final):
+                    shutil.rmtree(final)
+                os.rename(tmp, final)
+            except OSError as e:
+                obs_events.emit_event(
+                    "checkpoint_save", path=final, step=int(step), ok=False,
+                    attempt=attempt, error=str(e),
+                )
+                if attempt >= self.retries:
+                    raise CheckpointWriteError(
+                        f"checkpoint save for step {step} failed after "
+                        f"{attempt + 1} attempt(s) at seam ckpt_io: {e}"
+                    ) from e
+                if obsm.enabled():
+                    obsm.CHECKPOINT_RETRIES.inc()
+                if self.backoff_s:
+                    time.sleep(min(self.backoff_s * (2 ** attempt), 2.0))
+                attempt += 1
+                continue
+            obs_events.emit_event(
+                "checkpoint_save", path=final, step=int(step), ok=True,
+                attempt=attempt,
+            )
+            self._gc()
+            return final
+
+    def _write_state(self, state: Any, tmp_dir: str) -> None:
+        from thunder_tpu.distributed import checkpoint as dckpt
+
+        payload_dir = os.path.join(tmp_dir, "state")
+        try:
+            dckpt.save(state, payload_dir)
+        except ImportError:
+            # No Orbax in this environment: a host-local pickle keeps the
+            # single-process story (tests, CPU dev) working.
+            import pickle
+
+            os.makedirs(tmp_dir, exist_ok=True)
+            import jax
+
+            host_state = jax.tree_util.tree_map(
+                lambda x: __import__("numpy").asarray(x)
+                if isinstance(x, jax.Array) else x,
+                state,
+            )
+            with open(os.path.join(tmp_dir, "state.pkl"), "wb") as f:
+                pickle.dump(host_state, f)
+
+    def _read_state(self, step_dir: str) -> Any:
+        pkl = os.path.join(step_dir, "state.pkl")
+        if os.path.isfile(pkl):
+            import pickle
+
+            with open(pkl, "rb") as f:
+                return pickle.load(f)
+        from thunder_tpu.distributed import checkpoint as dckpt
+
+        return dckpt.load(os.path.join(step_dir, "state"))
+
+    def _gc(self) -> None:
+        steps = [s for s in self.steps_on_disk() if self._is_complete(s)]
+        for s in steps[:-self.keep] if self.keep > 0 else []:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    # -- restore --------------------------------------------------------------
+
+    def restore(self) -> tuple[Any, dict]:
+        """(state, meta) from the newest COMPLETE checkpoint. A step that
+        exists but is incomplete (no META — torn write) or fails to load
+        (corrupted payload) is quarantined as ``.corrupt`` and the next
+        newest complete step is tried; :class:`CheckpointRestoreError` when
+        none remain."""
+        candidates = [s for s in reversed(self.steps_on_disk())]
+        tried = []
+        for step in candidates:
+            step_dir = self._step_dir(step)
+            if not self._is_complete(step):
+                obs_events.emit_event(
+                    "checkpoint_restore", path=step_dir, step=step, ok=False,
+                    reason="incomplete (no commit marker)",
+                )
+                tried.append(step)
+                continue
+            try:
+                with open(os.path.join(step_dir, self.META)) as f:
+                    meta = json.load(f)
+                state = self._read_state(step_dir)
+            except Exception as e:  # corrupted payload/marker: fall back
+                obs_events.emit_event(
+                    "checkpoint_restore", path=step_dir, step=step, ok=False,
+                    reason=f"corrupted: {e}",
+                )
+                # Unique quarantine name: the same step can corrupt more than
+                # once across resume cycles, and rename onto an existing
+                # .corrupt dir would raise instead of falling back.
+                target = step_dir + ".corrupt"
+                n = 1
+                while os.path.exists(target):
+                    target = f"{step_dir}.corrupt.{n}"
+                    n += 1
+                os.rename(step_dir, target)
+                tried.append(step)
+                continue
+            obs_events.emit_event(
+                "checkpoint_restore", path=step_dir, step=step, ok=True,
+                fallback=bool(tried),
+            )
+            return state, meta
+        raise CheckpointRestoreError(
+            f"no complete checkpoint under {self.directory!r} "
+            f"(tried steps {tried or 'none'})"
+        )
+
+
+def resume(manager: CheckpointManager, init_state: Any) -> tuple[Any, int]:
+    """(state, start_step) — the restored newest complete checkpoint, or
+    ``(init_state, 0)`` for a fresh run. Restores the global RNG seed so
+    random ops continue the saved stream."""
+    if manager.latest_complete_step() is None:
+        return init_state, 0
+    state, meta = manager.restore()
+    if meta.get("rng_seed") is not None:
+        from thunder_tpu import api
+
+        api._global_rng["seed"] = int(meta["rng_seed"])
+    return state, int(meta["step"])
+
+
+def run_training(
+    step_fn: Callable,
+    state: Any,
+    n_steps: int,
+    *,
+    manager: CheckpointManager,
+    guard: Optional[PreemptionGuard] = None,
+    save_every: int = 0,
+    on_loss: Optional[Callable] = None,
+) -> tuple[Any, list]:
+    """Drive ``step_fn(state) -> (state, loss)`` for ``n_steps`` with
+    preemption-safe checkpointing.
+
+    Resumes from ``manager``'s newest complete checkpoint; checks the
+    preemption guard at every step boundary (multihost-synced) and, when
+    preemption is requested, saves and raises :class:`Preempted`;
+    ``save_every > 0`` also checkpoints on that cadence. Returns
+    ``(final_state, losses_this_run)``."""
+    from thunder_tpu import api
+
+    own_guard = guard is None
+    guard = guard if guard is not None else PreemptionGuard().install()
+    losses: list = []
+    try:
+        state, start = resume(manager, state)
+        for step in range(start, n_steps):
+            if guard.should_checkpoint(step):
+                path = manager.save(
+                    state, step, rng_seed=api._global_rng["seed"]
+                )
+                raise Preempted(step, path)
+            state, loss = step_fn(state)
+            losses.append(loss)
+            if on_loss is not None:
+                on_loss(step, loss)
+            done = step + 1
+            if save_every and done % save_every == 0 and done < n_steps:
+                manager.save(state, done, rng_seed=api._global_rng["seed"])
+        return state, losses
+    finally:
+        if own_guard:
+            guard.uninstall()

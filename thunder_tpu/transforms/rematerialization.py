@@ -40,11 +40,44 @@ _CHEAP_IDS = {
 
 _MAX_CHAIN = 64  # recompute-chain length bound
 
+# De-opt ladder escalation (resilience/deopt.py, level ≥ 2): under memory
+# pressure the ladder widens what counts as recomputable — reductions join
+# the cheap set and chains may run 4× longer — trading recompute FLOPs for
+# saved-for-backward bytes. RNG/collective/matmul results stay saved in
+# both modes (nondeterminism and MXU cost don't become cheap under an OOM).
+import contextlib
+import contextvars
+
+_aggressive = contextvars.ContextVar("thunder_tpu_remat_aggressive", default=False)
+_AGGRESSIVE_EXTRA_TAGS = {OpTags.REDUCTION_OP}
+
+
+@contextlib.contextmanager
+def aggressive_remat():
+    """Scope escalated rematerialization (the de-opt ladder's L2 knob)."""
+    tok = _aggressive.set(True)
+    try:
+        yield
+    finally:
+        _aggressive.reset(tok)
+
+
+def aggressiveness() -> str:
+    return "aggressive" if _aggressive.get() else "normal"
+
+
+def _max_chain() -> int:
+    return _MAX_CHAIN * 4 if _aggressive.get() else _MAX_CHAIN
+
 
 def _is_cheap(bsym) -> bool:
     if bsym.sym.id in _CHEAP_IDS:
         return True
-    return any(t in _CHEAP_TAGS for t in bsym.sym.tags)
+    if any(t in _CHEAP_TAGS for t in bsym.sym.tags):
+        return True
+    if _aggressive.get():
+        return any(t in _AGGRESSIVE_EXTRA_TAGS for t in bsym.sym.tags)
+    return False
 
 
 def rematerialize_forward_and_backward(
@@ -117,7 +150,7 @@ def rematerialize_forward_and_backward(
                         chain.append(b)
                 frontier |= sub_frontier
         chain.append(bsym)
-        if len(chain) > _MAX_CHAIN:
+        if len(chain) > _max_chain():
             memo[name] = None
             return None
         memo[name] = (chain, frontier)
