@@ -283,12 +283,30 @@ def _load_prev_round():
 
 
 def _bench_train():
+    # Compile-phase decomposition of the train compile total (ISSUE 8): the
+    # jax monitoring taps (api._jax_cache_counts) split the opaque
+    # train_xla_compile_s into real backend-compile seconds vs persistent-
+    # cache deserialize — the distinction the r4→r5 doubling needed
+    # (BENCHMARKS.md "compile-phase diagnosis").
+    from thunder_tpu.api import _jax_cache_counts
+
+    jax_c0 = _jax_cache_counts()
     jfn, flat_params, idx, tgt, init_s, trace_s, stage_s = build_train("open_llama_3b", TRAIN_B, TRAIN_T)
 
     t0 = time.perf_counter()
     flat_params, loss = jfn(flat_params, idx, tgt)
     loss0 = float(np.asarray(loss))
     compile_s = stage_s + time.perf_counter() - t0
+    jax_c1 = _jax_cache_counts()
+    phases = {
+        "trace_claim_s": round(trace_s, 2),
+        "staging_s": round(stage_s, 2),
+        "xla_backend_compile_s": round(jax_c1["backend_compile_s"] - jax_c0["backend_compile_s"], 2),
+        "persistent_cache_get_s": round(jax_c1["cache_get_s"] - jax_c0["cache_get_s"], 2),
+        "persistent_cache_hits": jax_c1["hits"] - jax_c0["hits"],
+        "persistent_cache_misses": jax_c1["misses"] - jax_c0["misses"],
+    }
+    print(f"# train compile phases: {phases}", file=sys.stderr)
 
     # Three timing protocols, all reported (ADVICE r3 / VERDICT r4: the A100
     # baseline constant comes from the reference's train.py, whose timed
@@ -349,7 +367,7 @@ def _bench_train():
         file=sys.stderr,
     )
     assert np.isfinite(loss_last) and loss_last < loss0, (loss0, loss_last)
-    return avg, synced_avg, strict_avg, total, trace_s, compile_s
+    return avg, synced_avg, strict_avg, total, trace_s, compile_s, phases
 
 
 def _bench_cache():
@@ -490,9 +508,15 @@ def main() -> None:
     monitor.enable()
     recompile_count, lookup_us = _bench_cache()
     fwd_avg, fwd_trace_s, fwd_compile_s, fwd_jfn, fwd_args = _bench_forward()
-    attribution = _bench_attribution(fwd_jfn, fwd_args)
     (train_avg, train_synced, train_strict, train_total,
-     train_trace_s, train_compile_s) = _bench_train()
+     train_trace_s, train_compile_s, train_phases) = _bench_train()
+    # Profile LAST among the compiling benches: the gated compile-seconds
+    # metrics must be measured before the process runs a profiler session,
+    # so a future profiler-side effect can never contaminate them (the
+    # r4->r5 diagnosis had to refute exactly this hypothesis by experiment
+    # — see BENCHMARKS.md "compile-phase diagnosis"; ordering it out keeps
+    # the refutation permanent).
+    attribution = _bench_attribution(fwd_jfn, fwd_args)
     # The end-to-end XLA compile totals as labelled histogram samples — the
     # metric whose 2x jump (r4->r5) per-pass ms could not see (ISSUE 5).
     obsm.XLA_COMPILE_S.observe(fwd_compile_s, cls="bench_forward")
@@ -538,6 +562,10 @@ def main() -> None:
         "fwd_xla_compile_s": round(fwd_compile_s, 1),
         "train_trace_claim_s": round(train_trace_s, 1),
         "train_xla_compile_s": round(train_compile_s, 1),
+        # Decomposition of the line above (ISSUE 8): backend-compile seconds
+        # vs persistent-cache deserialize + hit/miss counts, so the next
+        # compile-time swing names its phase instead of being one number.
+        "train_compile_phases": train_phases,
         # Dispatch-path health (cache="symbolic values" over 8 batch sizes):
         # recompiles per sweep and the warm O(1) cache lookup cost.
         "recompile_count": recompile_count,
@@ -558,7 +586,12 @@ def main() -> None:
     # Deltas vs the newest committed round (ISSUE 5): a >10% regression on
     # any gated metric warns HERE, in the run that introduced it — the
     # committed-history gate (scripts/perf_report.py --history) is the
-    # backstop, not the first line of defense.
+    # backstop, not the first line of defense. The keys are always present
+    # (vs_rev=None, empty deltas on a fresh clone with no committed
+    # BENCH_r*.json), so JSON consumers never need the glob to be non-empty.
+    result["vs_rev"] = None
+    result["deltas_vs_prev"] = {}
+    result["regressions_vs_prev"] = []
     prev_label, prev_metrics = _load_prev_round()
     if prev_metrics:
         try:
@@ -568,6 +601,7 @@ def main() -> None:
             cur_cmp["_metric_name"] = result["metric"]
             deltas, regressions = compare_rounds(prev_metrics, cur_cmp, threshold=0.10)
             result["prev_round"] = prev_label
+            result["vs_rev"] = prev_label  # the round every delta is against
             result["deltas_vs_prev"] = deltas
             result["regressions_vs_prev"] = regressions
             shown = {k: v for k, v in sorted(deltas.items(), key=lambda kv: -abs(kv[1]))[:8]}
@@ -577,6 +611,9 @@ def main() -> None:
                 print(f"# WARNING: regression vs {prev_label}: {r}", file=sys.stderr)
         except Exception as e:
             print(f"# delta computation failed ({type(e).__name__}: {e})", file=sys.stderr)
+    else:
+        print("# no committed BENCH_r*.json history; deltas skipped "
+              "(vs_rev=null)", file=sys.stderr)
 
     print(json.dumps(result))
 

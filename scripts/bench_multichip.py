@@ -1,0 +1,398 @@
+#!/usr/bin/env python
+"""Multichip benchmark: the FSDP×TP training step, measured.
+
+The distributed half of the bench story (single-host: ``bench.py``): run one
+full training step (fw+bw+optimizer, ``parallel.build_train_step``) over an
+n-device mesh — a virtual 8-device CPU mesh anywhere, real chips when the
+process already owns them — and measure what ``MULTICHIP_r*.json`` never
+recorded: per-step wall time under the three timing protocols, aggregate
+MFU from the PR 5 cost model, per-collective device time split into
+hidden-under-compute vs exposed-on-the-critical-path, and the compile-phase
+decomposition of the multichip XLA compile.
+
+Two workloads per run:
+
+1. **FSDP×TP step** (SPMD partitioner inserts the collectives): step
+   timings, MFU, and per-collective-family measured wire time from a
+   profiled run (``observability.attribution`` classifies ``all-gather``/
+   ``all-reduce``/... rows and computes the overlap split).
+2. **Explicit-collective FSDP step** (trace-level ``dist_prims`` under
+   ``shard_map``): every collective carries an ``L<idx>.<sym>#<pass>``
+   scope, so the overlap table joins *predicted* ring-factor wire time
+   (``analysis.cost``) against *measured* exposed time per trace line — the
+   before/after instrument for ROADMAP item 2's overlap work.
+
+Output: one JSON line on stdout (the committed ``MULTICHIP_BENCH_r*.json``
+series), consumed by ``scripts/perf_report.py --history
+MULTICHIP_BENCH_r*.json [--gate]`` with the same direction-aware deltas and
+noise floors as the single-host series. ``scripts/lint_traces.py
+--multichip`` runs a reduced-iteration smoke of this bench in CI.
+
+Usage::
+
+    python scripts/bench_multichip.py                 # 8 devices, defaults
+    python scripts/bench_multichip.py --devices 8 --iters 20 \
+        --out MULTICHIP_BENCH_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def _log(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr)
+
+
+def mesh_factors(n: int) -> dict:
+    """Factor n devices into fsdp × tp, fsdp-first (the ROADMAP item 2
+    shape): 8 → fsdp4·tp2, 4 → fsdp2·tp2, 2 → fsdp2, odd → fsdp=n."""
+    tp = 2 if n % 2 == 0 and n > 2 else 1
+    return {"fsdp": n // tp, "tp": tp}
+
+
+def _executors():
+    """Default to the jax executor: Pallas kernels run in interpret mode on
+    the CPU mesh (orders of magnitude slower, and not what multichip timing
+    should measure). THUNDER_BENCH_EXECUTORS overrides, as in bench.py."""
+    spec = os.environ.get("THUNDER_BENCH_EXECUTORS")
+    if not spec:
+        return ["jax"]
+    return [s.strip() for s in spec.split(",") if s.strip()]
+
+
+# =============================================================================
+# Workload 1: FSDP×TP training step (SPMD partitioner collectives)
+# =============================================================================
+
+
+def bench_fsdp_tp(args, result: dict) -> None:
+    import thunder_tpu as ttpu
+    from thunder_tpu.analysis.cost import resolve_device_spec, trace_cost
+    from thunder_tpu.api import _jax_cache_counts
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.observability.attribution import scope_map_of
+    from thunder_tpu.parallel import build_train_step, make_mesh
+    from thunder_tpu.parallel.sharding import gpt_param_specs
+
+    n = args.devices
+    factors = mesh_factors(n)
+    mesh = make_mesh(**factors)
+    cfg = m.name_to_config(args.model)
+    params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+    rng = np.random.RandomState(0)
+    B = args.batch or max(2, 2 * factors["fsdp"])
+    idx = rng.randint(0, cfg.vocab_size, (B, args.seq)).astype(np.int32)
+    tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+    specs = gpt_param_specs(cfg, mesh)
+
+    jax_c0 = _jax_cache_counts()
+    t0 = time.perf_counter()
+    step, opt, extrace = build_train_step(
+        cfg, params, idx, tgt, mesh=mesh, param_specs=specs, lr=1e-3,
+        executors=_executors(), donate=False, return_extrace=True,
+    )
+    trace_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    p, o, loss = step(params, opt, idx, tgt)
+    loss.block_until_ready()
+    compile_s = trace_s + time.perf_counter() - t0
+    jax_c1 = _jax_cache_counts()
+    loss0 = float(np.asarray(loss))
+    assert np.isfinite(loss0), loss0
+
+    # Async chain: iters steps threaded through the returned state, one sync.
+    for _ in range(2):
+        p, o, loss = step(p, o, idx, tgt)
+    loss.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        p, o, loss = step(p, o, idx, tgt)
+    loss_last = float(np.asarray(loss))
+    iter_s = (time.perf_counter() - t0) / args.iters
+
+    # Synced: every loss reaches the host before the next dispatch overlap
+    # (bench.py's protocol); strict: hard block per step.
+    n_sync = max(3, args.iters // 2)
+    t0 = time.perf_counter()
+    prev = None
+    for _ in range(n_sync):
+        p, o, loss = step(p, o, idx, tgt)
+        if prev is not None:
+            float(np.asarray(prev))
+        prev = loss
+    float(np.asarray(prev))
+    synced_s = (time.perf_counter() - t0) / n_sync
+    t0 = time.perf_counter()
+    for _ in range(n_sync):
+        p, o, loss = step(p, o, idx, tgt)
+        loss.block_until_ready()
+    strict_s = (time.perf_counter() - t0) / n_sync
+    assert np.isfinite(loss_last), loss_last
+
+    # Aggregate MFU: the traced program computes the GLOBAL batch, so its
+    # FLOPs divide across every chip — MFU is flops / (t · n · per-chip peak).
+    spec = resolve_device_spec(args.device_spec)
+    cost = trace_cost(extrace, spec)
+    mfu = cost.total_flops / (iter_s * n * spec.peak_flops["bf16"]) if iter_s else 0.0
+
+    _log(f"fsdp_tp mesh={factors} B={B} T={args.seq} compile {compile_s:.1f}s "
+         f"iter {iter_s * 1e3:.1f}ms (synced {synced_s * 1e3:.1f}ms, strict "
+         f"{strict_s * 1e3:.1f}ms) loss {loss0:.3f}->{loss_last:.3f} "
+         f"MFU {mfu * 100:.2f}% [{spec.name} x{n}]")
+
+    result.update({
+        "metric": "multichip_fsdp_tp_train_iter",
+        "value": round(iter_s, 4),
+        "unit": "s",
+        "n_devices": n,
+        "mesh": factors,
+        "model": args.model,
+        "batch": B,
+        "seq": args.seq,
+        "train_iter_s": round(iter_s, 4),
+        "train_iter_synced_s": round(synced_s, 4),
+        "train_iter_strict_sync_s": round(strict_s, 4),
+        "train_tokens_per_sec": round(B * args.seq / iter_s) if iter_s else 0,
+        "train_mfu": round(mfu, 5),
+        "device_spec": spec.name,
+        "train_flops_per_step": cost.total_flops,
+        "multichip_trace_claim_s": round(trace_s, 2),
+        "multichip_xla_compile_s": round(compile_s, 2),
+        "compile_phases": {
+            "trace_claim_s": round(trace_s, 2),
+            "xla_backend_compile_s": round(
+                jax_c1["backend_compile_s"] - jax_c0["backend_compile_s"], 2),
+            "persistent_cache_get_s": round(
+                jax_c1["cache_get_s"] - jax_c0["cache_get_s"], 2),
+            "persistent_cache_hits": jax_c1["hits"] - jax_c0["hits"],
+            "persistent_cache_misses": jax_c1["misses"] - jax_c0["misses"],
+        },
+    })
+
+    # Profiled run → per-collective measured wire time + overlap split.
+    if not args.no_profile:
+        import tempfile
+
+        trace_dir = tempfile.mkdtemp(prefix="thunder_mc_prof_")
+        try:
+            scope_map = scope_map_of(step, p, o, idx, tgt)
+        except Exception:
+            scope_map = {}
+        res = ttpu.profile(lambda: step(p, o, idx, tgt), trace_dir=trace_dir,
+                           steps=args.profile_steps, warmup=1)
+        if res["profiler"]:
+            from thunder_tpu.observability.attribution import attribute
+
+            attr = attribute(trace_dir, extra_scope_map=scope_map or None)
+            steps = args.profile_steps
+            coll = {
+                cls: {
+                    "us_per_step": round(row.us / steps, 1),
+                    "hidden_us_per_step": round(row.hidden_us / steps, 1),
+                    "exposed_us_per_step": round(row.exposed_us / steps, 1),
+                    "calls": row.count,
+                }
+                for cls, row in sorted(attr.collective_summary().items())
+            }
+            busy = attr.device_busy_us / steps
+            exposed = attr.exposed_collective_us / steps
+            result["collectives"] = coll
+            result["device_busy_us_per_step"] = round(busy, 1)
+            result["collective_us_per_step"] = round(attr.collective_us / steps, 1)
+            result["collective_exposed_pct"] = round(
+                exposed / busy * 100.0, 2) if busy else 0.0
+            _log(f"collectives: {attr.collective_us / steps:.0f}us/step on the wire "
+                 f"({result['collective_exposed_pct']}% of device time exposed): "
+                 + ", ".join(f"{c}={v['us_per_step']}us" for c, v in coll.items()))
+        else:
+            _log("profiler unavailable: collective attribution skipped")
+
+
+# =============================================================================
+# Workload 2: explicit-collective FSDP step (predicted vs measured overlap)
+# =============================================================================
+
+
+def bench_overlap(args, result: dict) -> None:
+    """Trace-level FSDP fw+bw under shard_map: `synchronize` all-gathers the
+    sharded weights, the grad reduce-scatters back — every collective is a
+    scoped trace line, so `monitor.attribution_report` joins the cost
+    model's ring-factor wire bound against measured exposed time per line."""
+    import tempfile
+
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import thunder_tpu as ttpu
+    import thunder_tpu.monitor as monitor
+    from thunder_tpu.analysis.cost import resolve_device_spec, trace_cost
+    from thunder_tpu.core.pytree import tree_flatten
+    from thunder_tpu.distributed import prims as dist
+    from thunder_tpu.distributed.runtime import compile_with_collectives
+    from thunder_tpu.parallel import make_mesh
+
+    n = args.devices
+    mesh = make_mesh(fsdp=n)
+    rng = np.random.RandomState(0)
+    d_in, d_hidden = 16 * n, 32 * n
+    w1 = rng.randn(d_hidden, d_in).astype(np.float32) * 0.1
+    w2 = rng.randn(d_in, d_hidden).astype(np.float32) * 0.1
+    x = rng.randn(64, d_in).astype(np.float32)
+
+    # Route through the trace pipeline: synchronize/reduce_scatter become
+    # trace lines the annotated codegen scopes.
+    import thunder_tpu.clang as clang
+
+    def loss_traced(w1_shard, w2_shard, x):
+        w1_full = dist.synchronize(w1_shard, "fsdp", n, "fsdp")
+        w2_full = dist.synchronize(w2_shard, "fsdp", n, "fsdp")
+        h = clang.tanh(clang.matmul(x, clang.transpose(w1_full, 0, 1)))
+        out = clang.matmul(h, clang.transpose(w2_full, 0, 1))
+        return clang.mean(clang.mul(out, out))
+
+    # Trace on per-device shard shapes; call with the global arrays —
+    # shard_map's in_specs do the splitting (tests/_dist_worker.py idiom).
+    w1s, w2s = w1[: d_hidden // n], w2[: d_in // n]
+    jf, extrace = compile_with_collectives(
+        loss_traced, (w1s, w2s, x), mesh,
+        (P("fsdp", None), P("fsdp", None), P()),
+        (P(), (P("fsdp", None), P("fsdp", None), P())),
+        grad=True,
+    )
+    flat = [jnp.asarray(a) for a in (w1, w2, x)]
+    out = jf(*flat)
+    tree_flatten(out)[0][0].block_until_ready()
+
+    trace_dir = tempfile.mkdtemp(prefix="thunder_mc_overlap_")
+    res = ttpu.profile(lambda: jf(*flat), trace_dir=trace_dir,
+                       steps=args.profile_steps, warmup=1)
+    if not res["profiler"]:
+        _log("profiler unavailable: overlap report skipped")
+        return
+    hlo_text = None
+    try:
+        if hasattr(jf, "lower"):
+            hlo_text = jf.lower(*flat).compile().as_text()
+    except Exception:
+        hlo_text = None
+    spec = resolve_device_spec(args.device_spec)
+    rep = monitor.attribution_report(
+        trace_dir, trace=extrace, device=spec, steps=args.profile_steps,
+        hlo_text=hlo_text,
+    )
+    for line in rep.format(5).splitlines():
+        _log(line)
+    result["overlap"] = [
+        {
+            "collective": c.key,
+            "class": c.cls,
+            "measured_us_per_step": round(c.us, 1),
+            "hidden_us_per_step": round(c.hidden_us, 1),
+            "exposed_us_per_step": round(c.exposed_us, 1),
+            "predicted_wire_us": (
+                round(c.predicted_wire_us, 2) if c.predicted_wire_us is not None else None
+            ),
+        }
+        for c in rep.collectives
+    ]
+    cost = trace_cost(extrace, spec)
+    result["overlap_predicted_comm_s"] = round(cost.comm_s, 6)
+
+
+# =============================================================================
+# Driver
+# =============================================================================
+
+
+def run(args) -> dict:
+    result: dict = {}
+    bench_fsdp_tp(args, result)
+    try:
+        bench_overlap(args, result)
+    except Exception as e:
+        # The overlap workload is diagnostic; its failure must not lose the
+        # timing series. The error is recorded so the smoke can assert on it.
+        _log(f"overlap workload failed ({type(e).__name__}: {e})")
+        result["overlap_error"] = f"{type(e).__name__}: {e}"
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench_multichip.py",
+        description="FSDP×TP multichip training-step benchmark (MULTICHIP_BENCH series)",
+    )
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--model", default="llama-tiny")
+    p.add_argument("--batch", type=int, default=0, help="global batch (0 = auto)")
+    p.add_argument("--seq", type=int, default=32)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--profile-steps", type=int, default=3)
+    p.add_argument("--no-profile", action="store_true")
+    p.add_argument("--device-spec", default=None,
+                   help="cost-model device spec (default: autodetect)")
+    p.add_argument("--out", default=None, help="also write the JSON to this path")
+    p.add_argument("--_subprocess", action="store_true", help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    import jax
+
+    if len(jax.devices()) < args.devices and not args._subprocess:
+        # Backend already initialized with fewer devices: re-exec on a
+        # virtual CPU mesh (same pattern as __graft_entry__.dryrun_multichip).
+        import subprocess
+
+        env = {
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "HOME": os.environ.get("HOME", "/root"),
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": f"--xla_force_host_platform_device_count={args.devices}",
+            "THUNDER_TPU_ANNOTATE_TRACES": os.environ.get("THUNDER_TPU_ANNOTATE_TRACES", "1"),
+        }
+        for k in ("THUNDER_BENCH_EXECUTORS", "THUNDER_TPU_EVENTS", "THUNDER_TPU_METRICS"):
+            if os.environ.get(k):
+                env[k] = os.environ[k]
+        cmd = [sys.executable, os.path.abspath(__file__), "--_subprocess"] + [
+            a for a in (argv if argv is not None else sys.argv[1:])
+        ]
+        r = subprocess.run(cmd, env=env, capture_output=True, text=True, timeout=1200)
+        sys.stderr.write(r.stderr[-4000:] if len(r.stderr) > 4000 else r.stderr)
+        if r.returncode != 0:
+            print(f"bench_multichip subprocess failed:\n{r.stdout[-2000:]}", file=sys.stderr)
+            return r.returncode
+        line = r.stdout.strip().splitlines()[-1]
+        json.loads(line)  # malformed output must fail loudly
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        return 0
+
+    # Annotated codegen so collective trace lines carry scopes in profiles.
+    os.environ.setdefault("THUNDER_TPU_ANNOTATE_TRACES", "1")
+    from thunder_tpu.api import _ensure_runtime
+
+    _ensure_runtime()
+    result = run(args)
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

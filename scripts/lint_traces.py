@@ -118,23 +118,104 @@ def _replay(paths: list, storm_threshold: int) -> int:
     return 1 if n_errors else 0
 
 
-def _bench_history_gate() -> int:
-    """Run the bench regression gate over the committed BENCH_r*.json
-    history (scripts/perf_report.py). Returns the number of errors (0 when
-    fewer than two committed rounds exist)."""
+def _bench_history_gate(glob_pat: str = "BENCH_r*.json") -> int:
+    """Run the bench regression gate over one committed bench series
+    (``BENCH_r*.json`` single-host, ``MULTICHIP_BENCH_r*.json`` multichip —
+    scripts/perf_report.py). Returns the number of errors (0 when fewer than
+    two committed rounds exist)."""
     import glob
 
     scripts_dir = os.path.dirname(os.path.abspath(__file__))
     repo_root = os.path.dirname(scripts_dir)
-    paths = sorted(glob.glob(os.path.join(repo_root, "BENCH_r*.json")))
+    paths = sorted(glob.glob(os.path.join(repo_root, glob_pat)))
     if len(paths) < 2:
         return 0
     if scripts_dir not in sys.path:
         sys.path.insert(0, scripts_dir)
     from perf_report import run_history_gate
 
-    print("--- bench regression gate (perf_report --history --gate)")
+    print(f"--- bench regression gate (perf_report --history --gate) [{glob_pat}]")
     return run_history_gate(paths, gate=True)
+
+
+# The committed MULTICHIP_BENCH schema: what every round must carry for the
+# series to stay comparable (scripts/bench_multichip.py emits these; the
+# --multichip smoke and docs/performance.md "distributed telemetry" assert
+# them).
+_MULTICHIP_REQUIRED_KEYS = (
+    "metric", "value", "unit", "n_devices", "mesh", "model", "batch", "seq",
+    "train_iter_s", "train_iter_synced_s", "train_iter_strict_sync_s",
+    "train_tokens_per_sec", "train_mfu", "device_spec", "train_flops_per_step",
+    "multichip_trace_claim_s", "multichip_xla_compile_s", "compile_phases",
+)
+
+
+def _multichip_smoke() -> int:
+    """--multichip: the distributed-observatory smoke (ISSUE 8 satellite).
+    Runs a reduced-iteration ``scripts/bench_multichip.py`` on an 8-device
+    virtual CPU mesh, asserts the bench JSON schema (every key the committed
+    ``MULTICHIP_BENCH_r*.json`` series gates on), asserts collective rows are
+    present in the profiled attribution with the hidden/exposed overlap
+    split, and runs ``perf_report.py --gate`` over the committed multichip
+    series. Returns the error count."""
+    import json
+    import subprocess
+    import tempfile
+
+    scripts_dir = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(tempfile.mkdtemp(prefix="ttpu_mc_smoke_"), "mc.json")
+    cmd = [sys.executable, os.path.join(scripts_dir, "bench_multichip.py"),
+           "--devices", "8", "--iters", "3", "--profile-steps", "2",
+           "--out", out_path]
+    print("--- multichip smoke: " + " ".join(cmd))
+    n_errors = 0
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=1200)
+    tail = r.stderr.strip().splitlines()[-12:]
+    for line in tail:
+        print(f"    {line}")
+    if r.returncode != 0:
+        print(f"    FAILED: bench_multichip exited {r.returncode}")
+        return 1
+    with open(out_path) as f:
+        result = json.load(f)
+
+    missing = [k for k in _MULTICHIP_REQUIRED_KEYS if k not in result]
+    if missing:
+        n_errors += 1
+        print(f"    FAILED: bench JSON missing keys: {missing}")
+    else:
+        print(f"    schema OK ({len(_MULTICHIP_REQUIRED_KEYS)} required keys)")
+
+    # Collective attribution: the profiled run must classify wire ops into
+    # per-family rows carrying the hidden/exposed split.
+    colls = result.get("collectives") or {}
+    bad = [c for c, v in colls.items()
+           if not all(k in v for k in
+                      ("us_per_step", "hidden_us_per_step",
+                       "exposed_us_per_step", "calls"))]
+    if not colls:
+        n_errors += 1
+        print("    FAILED: no collective rows in the profiled attribution "
+              "(expected all-gather/all-reduce/... on the FSDP×TP step)")
+    elif bad:
+        n_errors += 1
+        print(f"    FAILED: collective rows missing overlap fields: {bad}")
+    else:
+        print(f"    collective rows OK: {sorted(colls)} "
+              f"({result.get('collective_exposed_pct')}% of device time exposed)")
+
+    # The explicit-collective overlap workload (predicted-vs-measured table)
+    # is diagnostic: its absence is recorded, not fatal, but a recorded
+    # failure in the smoke IS an error — the seam must work in CI.
+    if result.get("overlap_error"):
+        n_errors += 1
+        print(f"    FAILED: overlap workload errored: {result['overlap_error']}")
+    elif result.get("overlap"):
+        print(f"    overlap table OK: {len(result['overlap'])} collective row(s)")
+
+    n_errors += _bench_history_gate("MULTICHIP_BENCH_r*.json")
+    print(f"\nlint_traces --multichip: {n_errors} error(s)")
+    return n_errors
 
 
 def _chaos_smoke() -> int:
@@ -247,7 +328,7 @@ def _chaos_smoke() -> int:
     return n_errors
 
 
-_USAGE = ("usage: lint_traces.py [pattern] | --chaos | "
+_USAGE = ("usage: lint_traces.py [pattern] | --chaos | --multichip | "
           "--events <log.jsonl> [...] [--storm-threshold N]")
 
 
@@ -256,6 +337,9 @@ def main(argv=None) -> int:
 
     if "--chaos" in argv:
         return 1 if _chaos_smoke() else 0
+
+    if "--multichip" in argv:
+        return 1 if _multichip_smoke() else 0
 
     if "--events" in argv:
         i = argv.index("--events")
@@ -314,10 +398,12 @@ def main(argv=None) -> int:
             n_errors += 1
             print(f"    FAILED: {e}")
 
-    # CI half of the perf observatory (ISSUE 5): a committed bench round
-    # regressing beyond threshold fails the lint run, not just a human's eye.
+    # CI half of the perf observatory (ISSUE 5/8): a committed bench round
+    # regressing beyond threshold — single-host or multichip series — fails
+    # the lint run, not just a human's eye.
     if not pattern:
         n_errors += _bench_history_gate()
+        n_errors += _bench_history_gate("MULTICHIP_BENCH_r*.json")
 
     print(f"\nlint_traces: {n_errors} error(s), {n_warnings} warning(s)")
     return 1 if n_errors else 0

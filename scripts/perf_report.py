@@ -9,6 +9,12 @@ bench rounds and flag per-metric deltas beyond thresholds::
     python scripts/perf_report.py --history BENCH_r0*.json
     python scripts/perf_report.py --history BENCH_r0*.json --gate   # CI: exit 1
                                                                     # on un-acked regressions
+    python scripts/perf_report.py --history MULTICHIP_BENCH_r*.json --gate
+
+The single-host (``BENCH_r*.json``, from ``bench.py``) and multichip
+(``MULTICHIP_BENCH_r*.json``, from ``scripts/bench_multichip.py``) series
+are gated separately — one invocation per glob — with the same
+direction-aware deltas, noise floors, and ack semantics.
 
 Metric direction is inferred from the name (times/counts: lower is better;
 MFU/throughput/ratios-vs-baseline: higher is better); sub-noise-floor
@@ -63,6 +69,22 @@ _NOISE_FLOORS = (
     ("lookup_us", 5.0),
     ("dispatch_us", 20.0),
     ("overhead_pct", 0.5),
+    ("exposed_pct", 5.0),
+)
+
+# Series-aware floors for the MULTICHIP_BENCH rounds (headline metric name
+# starts with "multichip"): tiny-model steps on an emulated 8-device CPU
+# mesh jitter tens of ms — and MFU/tokens track the same measurement — so
+# the floors are sized to that jitter WITHOUT weakening the single-host
+# BENCH gate, whose metrics share these names. Checked before the generic
+# table; "value" is the multichip headline (iter seconds).
+_MULTICHIP_NOISE_FLOORS = (
+    ("value", 0.02),
+    ("iter_s", 0.02),
+    ("synced_s", 0.02),
+    ("strict_sync_s", 0.02),
+    ("mfu", 5e-4),
+    ("tokens_per_sec", 2000.0),
 )
 
 
@@ -76,8 +98,15 @@ def metric_direction(name: str) -> Optional[int]:
     return None
 
 
-def noise_floor(name: str) -> float:
+def noise_floor(name: str, series: str = "") -> float:
+    """Minimum absolute delta for ``name`` to gate; ``series`` is the
+    round's headline ``metric`` name, selecting the multichip floor table
+    for MULTICHIP_BENCH rounds (the two series share metric names)."""
     low = name.lower()
+    if series.lower().startswith("multichip"):
+        for suffix, floor in _MULTICHIP_NOISE_FLOORS:
+            if low.endswith(suffix):
+                return floor
     for suffix, floor in _NOISE_FLOORS:
         if low.endswith(suffix):
             return floor
@@ -172,7 +201,8 @@ def analyze_history(
                 continue
             pct = (cur - prev) / abs(prev)
             bad = pct > threshold if direction < 0 else pct < -threshold
-            if not bad or abs(cur - prev) <= noise_floor(name):
+            series = str(m0.get("_metric_name") or m1.get("_metric_name") or "")
+            if not bad or abs(cur - prev) <= noise_floor(name, series):
                 continue
             r = Regression(metric=name, frm=l0, to=l1, prev=prev, cur=cur, pct=pct)
             if r.key in ack:
@@ -204,7 +234,8 @@ def compare_rounds(
         pct = (c - p) / abs(p)
         deltas[name] = round(pct, 4)
         bad = pct > threshold if direction < 0 else pct < -threshold
-        if bad and abs(c - p) > noise_floor(name):
+        series = str(prev.get("_metric_name") or cur.get("_metric_name") or "")
+        if bad and abs(c - p) > noise_floor(name, series):
             regs.append(f"{name} {p:g} -> {c:g} ({pct * 100:+.1f}%)")
     return deltas, regs
 

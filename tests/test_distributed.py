@@ -200,9 +200,15 @@ class TestExpertAndPipelineParallel:
     def test_pipeline_pp(self):
         _run_scenario("pipeline_pp")
 
+    @pytest.mark.slow
     def test_gpt_pipeline(self):
         """r5: real GPT split embed→blocks→head over pp=4, GPipe + 1F1B
-        parity, 1F1B activation-memory bound, pipelined training."""
+        parity, 1F1B activation-memory bound, pipelined training.
+
+        slow: ~46s of subprocess pipeline training — with the multi-device
+        module family revived (ISSUE 8 mesh-placement fix) the tier-1 suite
+        brushed its wall-clock budget, and the two >45s scenarios moved
+        under the documented slow marker (full runs still cover them)."""
         _run_scenario("gpt_pipeline", timeout=540)
 
 
@@ -216,5 +222,7 @@ class TestSequenceParallel:
     def test_ulysses_attention(self):
         _run_scenario("ulysses_attention")
 
+    @pytest.mark.slow
     def test_long_context_train(self):
+        # slow: ~65s subprocess run (see test_gpt_pipeline's note).
         _run_scenario("long_context_train")

@@ -1,0 +1,413 @@
+"""Distributed-observatory tests (ISSUE 8): compute–comm overlap attribution
+over synthetic xprof traces (collective classification, lane segmentation,
+hidden-vs-exposed wire time), compile-phase span events decomposing the
+opaque XLA-compile total, per-host prometheus labels (escaping included),
+and cross-host health — ``merge_event_logs`` over 8 simulated per-host logs
+with the chaos collective-straggler seam as the slow host's cause.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import thunder_tpu as ttpu
+import thunder_tpu.clang as clang
+import thunder_tpu.monitor as monitor
+from thunder_tpu.observability import events as obs_events
+from thunder_tpu.observability import metrics as obsm
+from thunder_tpu.observability.attribution import (
+    Attribution,
+    CollectiveRow,
+    _collect_overlap,
+    _lane_segments,
+    _merge_intervals,
+    _overlap_us,
+    attribute,
+    collective_class,
+    parse_scopes,
+)
+from thunder_tpu.observability.metrics import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _metrics_isolation():
+    was = monitor.enabled()
+    monitor.disable()
+    monitor.reset()
+    yield
+    monitor.reset()
+    (monitor.enable if was else monitor.disable)()
+
+
+@pytest.fixture
+def _fixed_host_identity():
+    """Let tests impersonate hosts: restores the frozen writer identity."""
+    saved = dict(obs_events._identity)
+    yield
+    obs_events._identity.clear()
+    obs_events._identity.update(saved)
+
+
+def _set_host(h: int) -> None:
+    obs_events._identity.clear()
+    obs_events._identity.update({"pid": os.getpid(), "host": h})
+
+
+# =============================================================================
+# Collective classification
+# =============================================================================
+
+
+class TestCollectiveClass:
+    def test_hlo_families(self):
+        assert collective_class("all-gather.3") == "all-gather"
+        assert collective_class("all-reduce-start.12") == "all-reduce"
+        assert collective_class("fusion.9", "reduce-scatter.1") == "reduce-scatter"
+        assert collective_class("collective-permute.2") == "collective-permute"
+        assert collective_class("dot.7") is None
+        assert collective_class("fusion.1", "multiply.3") is None
+
+    def test_scoped_trace_symbols_win(self):
+        # A scoped row classifies by the trace-level dist_prims symbol even
+        # when the event name itself is an opaque fusion label.
+        refs = parse_scopes("jit_f/L1.synchronize#Transform_for_execution/fusion.2")
+        assert collective_class("fusion.2", "", refs) == "all-gather"
+        refs = parse_scopes("L40.reduce_scatter#Transform_for_execution")
+        assert collective_class("whatever", "", refs) == "reduce-scatter"
+        refs = parse_scopes("L3.matmul#Transform_for_execution")
+        assert collective_class("matmul", "", refs) is None
+
+
+# =============================================================================
+# Lane segmentation + interval overlap
+# =============================================================================
+
+
+class TestLaneSegments:
+    def test_nested_call_split_around_children(self):
+        call = {"ts": 0.0, "dur": 100.0, "name": "call"}
+        child = {"ts": 20.0, "dur": 30.0, "name": "dot.1"}
+        segs = _lane_segments([call, child])
+        # At any instant the deepest open event owns the moment: the call
+        # wrapper's interval splits into [0,20) + [50,100) around the child.
+        by_name = {}
+        for s, e, ev in segs:
+            by_name.setdefault(ev["name"], []).append((s, e))
+        assert by_name["dot.1"] == [(20.0, 50.0)]
+        assert sorted(by_name["call"]) == [(0.0, 20.0), (50.0, 100.0)]
+
+    def test_merge_and_overlap(self):
+        merged = _merge_intervals([(0.0, 10.0), (5.0, 20.0), (30.0, 40.0)])
+        assert merged == [(0.0, 20.0), (30.0, 40.0)]
+        assert _overlap_us(15.0, 35.0, merged) == 10.0
+        assert _overlap_us(21.0, 29.0, merged) == 0.0
+
+
+# =============================================================================
+# Compute–comm overlap on synthetic traces
+# =============================================================================
+
+
+def _write_trace(path, events):
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+class TestOverlapAttribution:
+    def test_hidden_under_other_lane_compute_on_device_pid(self, tmp_path):
+        # TPU-shaped trace: pid 1 is a device; its two lanes are the compute
+        # stream and the async-collective stream. The collective's interval
+        # [40, 140) overlaps compute [0, 100) on the other lane for 60us.
+        evs = [
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 1, "tid": 10, "ts": 0.0, "dur": 100.0,
+             "name": "L2.matmul#Transform_for_execution"},
+            {"ph": "X", "pid": 1, "tid": 20, "ts": 40.0, "dur": 100.0,
+             "name": "all-gather.3"},
+        ]
+        p = tmp_path / "t.trace.json"
+        _write_trace(p, evs)
+        attr = attribute(str(p))
+        assert list(attr.collectives) == ["all-gather.3"]
+        row = attr.collectives["all-gather.3"]
+        assert row.cls == "all-gather"
+        assert row.us == 100.0
+        assert row.hidden_us == pytest.approx(60.0)
+        assert row.exposed_us == pytest.approx(40.0)
+        assert attr.collective_summary()["all-gather"].count == 1
+
+    def test_same_lane_compute_never_hides(self, tmp_path):
+        # A lane is serial: compute before the collective on the SAME lane
+        # cannot overlap it, so every wire microsecond is exposed.
+        evs = [
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 1, "tid": 10, "ts": 0.0, "dur": 50.0,
+             "name": "dot.1"},
+            {"ph": "X", "pid": 1, "tid": 10, "ts": 50.0, "dur": 80.0,
+             "name": "all-reduce.7"},
+        ]
+        p = tmp_path / "t.trace.json"
+        _write_trace(p, evs)
+        attr = attribute(str(p))
+        row = attr.collectives["all-reduce.7"]
+        assert row.hidden_us == 0.0 and row.exposed_us == 80.0
+
+    def test_host_pid_lanes_are_distinct_devices(self, tmp_path):
+        # CPU plugin: every emulated device's thread sits under one host
+        # pid. Concurrent compute on another lane is another device running
+        # in parallel — parallelism, not overlap — so hidden stays 0.
+        evs = [
+            {"ph": "M", "name": "process_name", "pid": 7,
+             "args": {"name": "python3"}},
+            {"ph": "X", "pid": 7, "tid": 1, "ts": 0.0, "dur": 100.0,
+             "name": "fusion.1", "args": {"hlo_op": "multiply.3"}},
+            {"ph": "X", "pid": 7, "tid": 2, "ts": 0.0, "dur": 100.0,
+             "name": "all-gather.1", "args": {"hlo_op": "all-gather.1"}},
+        ]
+        p = tmp_path / "t.trace.json"
+        _write_trace(p, evs)
+        attr = attribute(str(p))
+        row = attr.collectives["all-gather.1"]
+        assert row.hidden_us == 0.0 and row.exposed_us == 100.0
+
+    def test_scoped_collective_keys_by_trace_line(self, tmp_path):
+        evs = [
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 1, "tid": 10, "ts": 0.0, "dur": 30.0,
+             "name": "jit_f/L1.synchronize#Transform_for_execution/all-gather.2"},
+        ]
+        p = tmp_path / "t.trace.json"
+        _write_trace(p, evs)
+        attr = attribute(str(p))
+        (key,) = attr.collectives
+        assert key == "L1.synchronize#Transform_for_execution"
+        assert attr.collectives[key].cls == "all-gather"
+        # The scoped row is simultaneously charged to the trace line.
+        assert any(r.sym == "synchronize" for r in
+                   (ref for ref, _ in attr.by_line.items()))
+
+    def test_collect_overlap_units(self):
+        # Direct unit: two lanes of one device pid, idle rows skipped.
+        attr = Attribution()
+        process_names = {1: "/device:TPU:0"}
+        evs = [
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 0.0, "dur": 10.0, "name": "Idle"},
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 10.0, "dur": 40.0, "name": "dot.1"},
+            {"ph": "X", "pid": 1, "tid": 2, "ts": 0.0, "dur": 50.0,
+             "name": "reduce-scatter.4"},
+        ]
+        _collect_overlap(attr, evs, process_names, {})
+        row = attr.collectives["reduce-scatter.4"]
+        # Idle on the other lane hides nothing; the dot does [10, 50).
+        assert row.hidden_us == pytest.approx(40.0)
+        assert row.exposed_us == pytest.approx(10.0)
+
+    def test_collective_row_props(self):
+        r = CollectiveRow(key="k", cls="all-reduce", us=10.0, hidden_us=4.0, count=2)
+        assert r.exposed_us == 6.0
+        assert r.hidden_frac == pytest.approx(0.4)
+
+
+# =============================================================================
+# Compile-phase spans
+# =============================================================================
+
+
+class TestCompilePhases:
+    def test_compile_phase_events_and_cache_info(self, tmp_path):
+        monitor.enable()
+        log = str(tmp_path / "ev.jsonl")
+
+        def f(x):
+            return clang.sum(clang.tanh(x))
+
+        jf = ttpu.jit(f, executors=["jax"], events=log)
+        jf(np.ones((4, 4), np.float32))
+
+        recs = [json.loads(l) for l in open(log)]
+        spans = [r for r in recs if r["kind"] == "compile_phase"]
+        phases = {r["phase"] for r in spans}
+        # The opaque xla_compile_s total, decomposed: build-side spans plus
+        # the first-run XLA compile itself.
+        assert {"trace", "transforms", "claim", "codegen", "staging",
+                "xla_compile"} <= phases
+        # Every span correlates to the same compile.
+        cids = {r["compile_id"] for r in spans}
+        assert len(cids) == 1 and None not in cids
+        assert all(isinstance(r["s"], (int, float)) for r in spans)
+
+        # Histogram side of the same decomposition.
+        s = obsm.COMPILE_PHASE_S.summary(phase="trace")
+        assert s is not None and s["count"] == 1
+
+        # cache_info rolls the per-entry spans up.
+        info = ttpu.cache_info(jf)
+        assert info["compile_phase_seconds"].get("xla_compile", 0.0) > 0.0
+        assert "trace" in info["compile_phase_seconds"]
+
+    def test_replay_aggregates_compile_phases(self, tmp_path):
+        from thunder_tpu.analysis.events import replay_events
+
+        log = str(tmp_path / "ev.jsonl")
+        jf = ttpu.jit(lambda x: clang.sum(clang.tanh(x)),
+                      executors=["jax"], events=log)
+        jf(np.ones((2, 2), np.float32))
+        summary, diags = replay_events(log)
+        from thunder_tpu.analysis import Severity
+
+        assert not [d for d in diags if d.severity >= Severity.ERROR]
+        totals = summary["compile_phase_s_total"]
+        assert any(k.startswith("xla_compile") for k in totals)
+        assert "trace" in totals
+
+
+# =============================================================================
+# Prometheus host labels
+# =============================================================================
+
+
+class TestPrometheusHostLabels:
+    def test_extra_labels_on_every_series(self):
+        monitor.enable()
+        r = MetricsRegistry()
+        r.counter("a_total", "ha").inc(2, executor="jax")
+        r.histogram("h_us").observe(7.0)
+        text = r.prometheus_text(extra_labels={"host": "0", "pid": "41"})
+        assert 'a_total{executor="jax",host="0",pid="41"} 2' in text
+        assert 'h_us_bucket{host="0",le="10.0",pid="41"} 1' in text
+        assert 'h_us_sum{host="0",pid="41"} 7.0' in text
+        assert 'h_us_count{host="0",pid="41"} 1' in text
+
+    def test_label_value_escaping_golden(self):
+        # Hostnames are arbitrary strings: backslash, quote, and newline
+        # must be escaped per the exposition format or the scrape line is
+        # malformed.
+        monitor.enable()
+        r = MetricsRegistry()
+        r.counter("esc_total").inc(1)
+        text = r.prometheus_text(
+            extra_labels={"host": 'node"a\\b\nc', "pid": "7"})
+        assert 'esc_total{host="node\\"a\\\\b\\nc",pid="7"} 1' in text
+
+    def test_monitor_include_host(self, _fixed_host_identity):
+        monitor.enable()
+        _set_host(3)
+        obsm.CACHE_MISSES.inc()
+        text = monitor.prometheus_text(include_host=True)
+        assert 'host="3"' in text and f'pid="{os.getpid()}"' in text
+        # Default stays label-free: single-host scrapes are unchanged.
+        assert 'host=' not in monitor.prometheus_text()
+        rep = monitor.report(include_host=True)
+        assert rep["host_identity"]["host"] == "3"
+
+
+# =============================================================================
+# Cross-host health: merge + straggler detection
+# =============================================================================
+
+
+class TestHostHealth:
+    def _simulate_fleet(self, tmp_path, n_hosts=8, straggler=5):
+        """Eight per-host logs from the SAME training loop, the slow host
+        caused by the PR 6 chaos collective-straggler seam (a real injected
+        dispatch-time delay, not a doctored timestamp)."""
+        from thunder_tpu.resilience.preemption import CheckpointManager, run_training
+
+        paths = []
+        for h in range(n_hosts):
+            path = str(tmp_path / f"host{h}.jsonl")
+            paths.append(path)
+            chaos = "straggler@any~0.2*inf" if h == straggler else None
+            jf = ttpu.jit(lambda x: clang.sum(clang.tanh(x)),
+                          executors=["jax"], chaos=chaos)
+
+            def step_fn(s, jf=jf):
+                # A 20ms step floor keeps scheduler jitter small relative
+                # to the baseline; the injected straggler delay (200ms)
+                # still dominates by 10x — margins sized so a loaded CI
+                # host's stalls on a clean host stay under the threshold.
+                import time
+
+                time.sleep(0.02)
+                return s, float(np.asarray(jf(s)))
+
+            # Warm outside the measured loop: step_time must capture
+            # steady-state steps (the straggler delay), not compile noise.
+            jf(np.ones((4, 4), np.float32))
+            _set_host(h)
+            mgr = CheckpointManager(str(tmp_path / f"ck{h}"), backoff_s=0)
+            with obs_events.event_scope(obs_events.log_for_path(path)):
+                run_training(step_fn, np.ones((4, 4), np.float32), 3, manager=mgr)
+        return paths
+
+    def test_straggler_detected_across_8_hosts(self, tmp_path, _fixed_host_identity):
+        from thunder_tpu.analysis.events import merge_event_logs
+
+        monitor.enable()
+        paths = self._simulate_fleet(tmp_path)
+
+        records, diags = merge_event_logs(paths)
+        steps = [r for r in records if r.get("kind") == "step_time"]
+        assert len(steps) == 24  # 8 hosts x 3 steps
+        assert {r["host"] for r in steps} == set(range(8))
+
+        # The coordinator republishes fleet health through the same
+        # metrics/events pipe: run the summary with an active log and
+        # assert the straggler_suspect event + gauges.
+        _set_host(0)
+        out_log = str(tmp_path / "coordinator.jsonl")
+        with obs_events.event_scope(obs_events.log_for_path(out_log)):
+            summary, hdiags = monitor.host_health(paths, spread_threshold=3.0)
+
+        # The injected host must be flagged AND be the fleet's worst; a
+        # loaded CI box can (rarely) stall a clean host past threshold too,
+        # so the assertions pin the signal, not the exact suspect list.
+        assert 5 in summary["stragglers"]
+        assert summary["spread_ratio"] > 3.0
+        assert len(summary["hosts"]) == 8
+        assert max(summary["hosts"], key=lambda h: summary["hosts"][h]["mean_s"]) == 5
+
+        warn = [d for d in hdiags if d.rule == "events.straggler-suspect"]
+        assert any("host 5" in d.message for d in warn)
+
+        emitted = [json.loads(l) for l in open(out_log)]
+        suspects = [r for r in emitted if r["kind"] == "straggler_suspect"]
+        assert any(r["host"] == 5 and r["ratio"] > 3.0 for r in suspects)
+
+        # Gauges: per-host mean + the fleet spread ratio.
+        assert obsm.HOST_STEP_SPREAD.value() == pytest.approx(
+            summary["spread_ratio"], rel=1e-3)
+        assert obsm.HOST_STEP_TIME_S.value(host="5") == pytest.approx(
+            summary["hosts"][5]["mean_s"])
+
+    def test_even_fleet_no_stragglers(self, tmp_path, _fixed_host_identity):
+        monitor.enable()
+        recs = [{"kind": "step_time", "host": h, "s": 0.01 + 0.0001 * h,
+                 "fn": "f", "step": 0} for h in range(8)]
+        summary, diags = monitor.host_health(recs)
+        assert summary["stragglers"] == []
+        assert not diags
+        assert summary["spread_ratio"] < 1.5
+
+    def test_no_step_events(self):
+        summary, diags = monitor.host_health([])
+        assert summary["hosts"] == {} and summary["spread_ratio"] is None
+
+    def test_even_fleet_true_median(self):
+        # Even host counts average the middle pair: with the upper-middle
+        # element as "median", a 2-host fleet's slow host would be its own
+        # baseline (spread 1.0) and a 4x skew would go undetected.
+        monitor.enable()
+        recs = [
+            {"kind": "step_time", "host": 0, "s": 0.01, "fn": "f", "step": 0},
+            {"kind": "step_time", "host": 1, "s": 0.04, "fn": "f", "step": 0},
+        ]
+        summary, diags = monitor.host_health(recs, spread_threshold=1.5)
+        assert summary["spread_ratio"] == pytest.approx(0.04 / 0.025)
+        assert summary["stragglers"] == [1]
+        assert len(diags) == 1

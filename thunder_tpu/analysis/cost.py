@@ -297,6 +297,16 @@ def _collective_cost(bsym) -> OpCost:
         if isinstance(v, int) and not isinstance(v, bool) and v > 1:
             g = v
             break
+    # Gather-type ops consume the SHARD but the ring moves (g-1)/g of the
+    # FULL tensor — the output. This covers `synchronize` on a sharded fsdp
+    # param (trace-level all-gather; the replicated passthrough keeps its
+    # zero factor since out == in) so the overlap report's predicted column
+    # prices the dominant FSDP collective instead of calling it free.
+    if name in ("all_gather", "synchronize"):
+        out = bsym.output
+        out_bytes = float(getattr(out, "size_bytes", 0.0) or 0.0)
+        if out_bytes > nbytes:
+            return OpCost(comm_bytes=(g - 1) / g * out_bytes, kind="collective")
     return OpCost(comm_bytes=factor_fn(g) * nbytes, kind="collective")
 
 
@@ -406,6 +416,20 @@ class TraceCost:
     def memory_s(self) -> float:
         """Pure-bandwidth bound (every FLOP free)."""
         return self.total_bytes / self.device.hbm_bw
+
+    @property
+    def comm_s(self) -> float:
+        """Pure-wire bound: total ring-collective traffic at ICI bandwidth
+        (0 when the trace has no collectives or the spec has no ICI)."""
+        if not self.total_comm_bytes or not self.device.ici_bw:
+            return 0.0
+        return self.total_comm_bytes / self.device.ici_bw
+
+    def collective_rows(self) -> list[OpCostRow]:
+        """The trace's collective ops — the predicted half of the
+        compute–comm overlap report (observability/attribution.py joins
+        these against measured hidden/exposed wire time)."""
+        return [r for r in self.rows if r.kind == "collective"]
 
     def by_kind(self) -> dict[str, dict]:
         out: dict[str, dict] = {}

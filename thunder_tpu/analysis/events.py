@@ -38,6 +38,10 @@ SCHEMA: dict[str, frozenset] = {
     "nan_watch": frozenset({"value_kind", "symbol", "bsym_index", "line", "provenance"}),
     "profile_start": frozenset({"dir", "steps"}),
     "profile_stop": frozenset({"steps", "total_s", "avg_s", "profiler"}),
+    # Distributed observatory (docs/observability.md "distributed telemetry").
+    "compile_phase": frozenset({"compile_id", "phase", "s"}),
+    "step_time": frozenset({"fn", "step", "s"}),
+    "straggler_suspect": frozenset({"host", "mean_s", "ratio"}),
     # Resilience subsystem (thunder_tpu/resilience; docs/robustness.md).
     "fault_injected": frozenset({"seam", "target", "n"}),
     "executor_demoted": frozenset({"sym", "executor", "ttl_s", "reason"}),
@@ -122,6 +126,85 @@ def merge_event_logs(paths: list[str]) -> tuple[list[dict], list[Diagnostic]]:
     return [rec for _, _, rec in records], diags
 
 
+def host_health(
+    source,
+    *,
+    spread_threshold: float = 1.5,
+) -> tuple[dict, list[Diagnostic]]:
+    """Cross-host health summary over merged per-host event logs: per-host
+    step-time statistics from ``step_time`` events, the fleet spread ratio
+    (slowest host mean / fleet median), and straggler suspects.
+
+    ``source``: a list of per-host log paths (merged via
+    :func:`merge_event_logs`), or an already-merged record list. A host
+    whose mean step time exceeds ``spread_threshold`` × the fleet median is
+    flagged with an ``events.straggler-suspect`` diagnostic; the spread is
+    surfaced as the ``thunder_tpu_host_step_time_spread_ratio`` gauge (per-
+    host means as ``thunder_tpu_host_step_time_s{host=...}``) and each
+    suspect emits a ``straggler_suspect`` event to the active log — so the
+    coordinator that runs the merge republishes fleet health through the
+    same metrics/events pipe everything else uses."""
+    diags: list[Diagnostic] = []
+    if isinstance(source, (list, tuple)) and source and isinstance(source[0], str):
+        records, diags0 = merge_event_logs(list(source))
+        diags.extend(diags0)
+    else:
+        records = list(source)
+
+    per_host: dict[Any, list[float]] = {}
+    for rec in records:
+        if not isinstance(rec, dict) or rec.get("kind") != "step_time":
+            continue
+        try:
+            s = float(rec["s"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        per_host.setdefault(rec.get("host") or 0, []).append(s)
+
+    hosts = {
+        h: {
+            "steps": len(ts),
+            "mean_s": sum(ts) / len(ts),
+            "max_s": max(ts),
+        }
+        for h, ts in per_host.items()
+    }
+    summary: dict[str, Any] = {"hosts": hosts, "spread_ratio": None, "stragglers": []}
+    if hosts:
+        means = sorted(st["mean_s"] for st in hosts.values())
+        # True median (even fleets average the middle pair): taking the
+        # upper-middle element would make the slow host of a 2-host fleet
+        # its own baseline and hide the skew entirely.
+        mid = len(means) // 2
+        median = means[mid] if len(means) % 2 else 0.5 * (means[mid - 1] + means[mid])
+        spread = max(means) / median if median else 0.0
+        summary["spread_ratio"] = round(spread, 4)
+        from thunder_tpu.observability import metrics as obsm
+        from thunder_tpu.observability.events import emit_event
+
+        if obsm.enabled():
+            obsm.HOST_STEP_SPREAD.set(spread)
+            for h, st in hosts.items():
+                obsm.HOST_STEP_TIME_S.set(st["mean_s"], host=str(h))
+        for h, st in sorted(hosts.items()):
+            if median and st["mean_s"] > spread_threshold * median:
+                ratio = st["mean_s"] / median
+                summary["stragglers"].append(h)
+                emit_event("straggler_suspect", host=h,
+                           mean_s=round(st["mean_s"], 6), ratio=round(ratio, 4))
+                diags.append(Diagnostic(
+                    rule="events.straggler-suspect", severity=Severity.WARNING,
+                    message=(
+                        f"host {h} mean step time {st['mean_s'] * 1e3:.2f} ms is "
+                        f"{ratio:.2f}x the fleet median ({median * 1e3:.2f} ms) "
+                        f"over {st['steps']} steps — straggler suspect"
+                    ),
+                    hint="per-host step logs merge via merge_event_logs; the "
+                         "spread gauge is thunder_tpu_host_step_time_spread_ratio",
+                ))
+    return summary, diags
+
+
 def replay_events(
     path,
     *,
@@ -143,6 +226,7 @@ def replay_events(
     exact_compiles_by_fn: dict[str, int] = {}
     recompiles_by_fn: dict[str, int] = {}
     pass_ms: dict[str, float] = {}
+    phase_s: dict[str, float] = {}
     seq_bucket_compiles_by_fn: dict[str, int] = {}
     open_compiles: dict[Any, str] = {}
     cache_option_by_cid: dict[Any, str] = {}
@@ -233,6 +317,12 @@ def replay_events(
             elif kind == "pass":
                 if rec["ms"] is not None:
                     pass_ms[rec["name"]] = pass_ms.get(rec["name"], 0.0) + float(rec["ms"])
+            elif kind == "compile_phase":
+                if rec["s"] is not None:
+                    key = str(rec["phase"])
+                    if rec.get("cache"):
+                        key = f"{key}[{rec['cache']}]"
+                    phase_s[key] = phase_s.get(key, 0.0) + float(rec["s"])
             elif kind == "bucket_select":
                 buckets.append(str(rec["buckets"]))
                 bucket_by_cid[(*_writer(rec), rec["compile_id"])] = str(rec["buckets"])
@@ -325,6 +415,7 @@ def replay_events(
         "bucket_compiles": {f"{fn}: {d}": n for (fn, d), n in sorted(bucket_compile_counts.items())},
         "recompiles_by_fn": recompiles_by_fn,
         "pass_ms_total": {k: round(v, 3) for k, v in sorted(pass_ms.items())},
+        "compile_phase_s_total": {k: round(v, 4) for k, v in sorted(phase_s.items())},
         "bucket_selects": buckets,
         "sharp_edges": sharp_edges,
         "faults_injected": [f"{seam}@{rec.get('target')}" for _, seam, rec in fault_events],
@@ -346,6 +437,10 @@ def format_replay(summary: dict, diags: list[Diagnostic]) -> str:
     if summary["pass_ms_total"]:
         lines.append("  pass time (ms): " + ", ".join(
             f"{k}={v}" for k, v in summary["pass_ms_total"].items()
+        ))
+    if summary.get("compile_phase_s_total"):
+        lines.append("  compile phases (s): " + ", ".join(
+            f"{k}={v}" for k, v in summary["compile_phase_s_total"].items()
         ))
     if summary["bucket_selects"]:
         lines.append(f"  bucket selects: {len(summary['bucket_selects'])}")

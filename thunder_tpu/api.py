@@ -498,6 +498,65 @@ def _compile_entry_checked(
     return _compile_entry_impl(cd, cs, args, kwargs, sym_spec, compile_id, deopt_level)
 
 
+# Persistent-XLA-cache verdicts, tapped from jax's monitoring events: the
+# compile_phase span for an entry's first run says whether the seconds went
+# to a real backend compile (cache miss) or a cache-entry deserialize (hit)
+# — the distinction that explains 2x swings in xla-compile totals between
+# otherwise identical rounds (BENCHMARKS.md, r4→r5 diagnosis).
+_jax_cache_events = {
+    "hits": 0, "misses": 0, "backend_compile_s": 0.0, "cache_get_s": 0.0,
+    "installed": False,
+}
+
+
+def _install_jax_cache_listener() -> None:
+    if _jax_cache_events["installed"]:
+        return
+    _jax_cache_events["installed"] = True
+    try:
+        from jax._src import monitoring
+
+        def _on_event(event: str, **kw) -> None:
+            if event == "/jax/compilation_cache/cache_hits":
+                _jax_cache_events["hits"] += 1
+            elif event == "/jax/compilation_cache/cache_misses":
+                _jax_cache_events["misses"] += 1
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            if event == "/jax/core/compile/backend_compile_duration":
+                _jax_cache_events["backend_compile_s"] += duration
+            elif event == "/jax/compilation_cache/cache_retrieval_time_sec":
+                _jax_cache_events["cache_get_s"] += duration
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # internal jax API: absence degrades to cache=None
+        _jax_cache_events["installed"] = False
+
+
+def _jax_cache_counts() -> dict:
+    return {k: _jax_cache_events[k]
+            for k in ("hits", "misses", "backend_compile_s", "cache_get_s")}
+
+
+def _record_compile_phase(compile_id, phase: str, seconds: float, *,
+                          log=None, **extra) -> None:
+    """One compile-pipeline span: a ``compile_phase`` event (correlated by
+    compile_id) + the ``thunder_tpu_compile_phase_s{phase=...}`` histogram.
+    Together the spans decompose what ``thunder_tpu_xla_compile_s`` reports
+    as one opaque number."""
+    extra = {k: v for k, v in extra.items() if v is not None}
+    if obsm.enabled():
+        labels = {"phase": phase}
+        if extra.get("cache"):
+            labels["cache"] = extra["cache"]
+        obsm.COMPILE_PHASE_S.observe(seconds, **labels)
+    target = log if log is not None else obs_events.active_log()
+    if target is not None:
+        target.emit("compile_phase", compile_id=compile_id, phase=phase,
+                    s=round(seconds, 6), **extra)
+
+
 def _compile_entry_impl(
     cd: CompileData, cs: CompileStats, args: tuple, kwargs: dict, sym_spec,
     compile_id: Optional[int], deopt_level: int,
@@ -507,6 +566,7 @@ def _compile_entry_impl(
     from thunder_tpu.core.trace import mark
 
     build_start = timer_ns()
+    phases: dict[str, Any] = {}
     cs.compile_count += 1
     # Chaos seam: injected XLA compile failure/timeout — lands on the same
     # recovery path (the de-opt ladder) as the real thing.
@@ -522,6 +582,8 @@ def _compile_entry_impl(
     mark(comp_trc, "Acquisition")
     mark(plg_trc, "Prologue construction")
     cs.last_trace_tracing_stop = timer_ns()
+    phases["trace"] = (cs.last_trace_tracing_stop - cs.last_trace_tracing_start) / 1e9
+    _phase_mark = timer_ns()
 
     input_mutations = getattr(comp_trc, "_input_mutations", None) or []
     if input_mutations and cd.compile_options.get("_trace_transforms"):
@@ -584,8 +646,12 @@ def _compile_entry_impl(
     if comp_trc.tags.get(RNG_TAG):
         computation_traces.append(comp_trc)
 
+    phases["transforms"] = (timer_ns() - _phase_mark) / 1e9
+    _phase_mark = timer_ns()
     extrace = transform_for_execution(comp_trc, cd.executors_list)
     computation_traces.append(extrace)
+    phases["claim"] = (timer_ns() - _phase_mark) / 1e9
+    _phase_mark = timer_ns()
 
     # Chaos seam: NaN-poison a chosen BoundSymbol (after claiming, so the
     # poison survives into both the staged entry and the instrumented
@@ -644,6 +710,10 @@ def _compile_entry_impl(
     _maybe_dump_trace(extrace)
     prologue_fn = plg_ex.python_callable()
     trace_callable = extrace.python_callable()
+    # Everything between claiming and here: chaos/instrument passes,
+    # del_last_used, the prologue claim, and source codegen + exec.
+    phases["codegen"] = (timer_ns() - _phase_mark) / 1e9
+    _phase_mark = timer_ns()
 
     needs_rng = bool(extrace.tags.get(RNG_TAG))
     device_sync = _has_tag_in_trace(extrace, OpTags.DEVICE_SYNC_OP)
@@ -662,6 +732,9 @@ def _compile_entry_impl(
         )
     else:
         computation_fn = jax.jit(trace_callable)
+    # jax.jit wrapper construction only — the XLA compile itself happens at
+    # the entry's first run (the xla_compile phase recorded in fn_).
+    phases["staging"] = (timer_ns() - _phase_mark) / 1e9
 
     torch_facing = any(bridge.is_torch_tensor(x) for x in tree_flatten((args, kwargs))[0])
 
@@ -686,7 +759,11 @@ def _compile_entry_impl(
     )
     entry.stats.trace_s = (timer_ns() - build_start) / 1e9
     entry.stats.degradation_level = deopt_level
+    entry.stats.phases = phases
+    entry.compile_id = compile_id
     cs.trace_seconds += entry.stats.trace_s
+    for phase in ("trace", "transforms", "claim", "codegen", "staging"):
+        _record_compile_phase(compile_id, phase, phases.get(phase, 0.0))
 
     # Observability: compile-side metrics + the compile_end event carrying
     # the executor-claim breakdown and static collective traffic of the
@@ -1066,6 +1143,15 @@ def _pad_concrete(x: Any, targets: dict):
     return jnp.pad(x, widths)
 
 
+def _sum_phases(entries) -> dict:
+    out: dict[str, float] = {}
+    for e in entries:
+        for phase, v in e.stats.phases.items():
+            if isinstance(v, (int, float)):
+                out[phase] = out.get(phase, 0.0) + v
+    return {k: round(v, 6) for k, v in sorted(out.items())}
+
+
 def cache_info(fn: Callable) -> dict:
     """Cache observability for a thunder_tpu-compiled function: aggregate and
     per-entry hit/miss/recompile counters plus cumulative trace/first-run
@@ -1085,6 +1171,10 @@ def cache_info(fn: Callable) -> dict:
         "trace_seconds": cs.trace_seconds,
         "first_run_seconds": cs.first_run_seconds,
         "cache_lookup_us_total": cs.cache_lookup_ns / 1e3,
+        # Compile-phase rollup across entries (seconds per phase): the
+        # decomposition of trace_seconds + first_run_seconds the
+        # compile_phase events record per compile (docs/observability.md).
+        "compile_phase_seconds": _sum_phases(cs.cache_entries),
         # De-opt ladder position new compiles use (per-entry levels are in
         # each entry's stats below) — resilience/deopt.py.
         "degradation_level": deopt_mod.current_level(cd) if cd is not None else 0,
@@ -1118,6 +1208,10 @@ def _ensure_runtime() -> None:
 
     if not jax.config.jax_enable_x64:
         jax.config.update("jax_enable_x64", True)
+
+    # Tap jax's compilation-cache monitoring events so first-run compile
+    # spans can say "hit" (deserialize) vs "miss" (real backend compile).
+    _install_jax_cache_listener()
 
     # Persistent XLA compilation cache (reference analogue: nvFuser's
     # descriptor-keyed compiled-fusion cache, SURVEY.md §2.2 — here the
@@ -1472,6 +1566,7 @@ def jit(
             cs.prologue_runs += 1
             entry.stats.prologue_runs += 1
             flat_inps = entry.prologue_fn(*args, **kwargs)
+            jax_compile0 = _jax_cache_counts()
             run_start = timer_ns()
             try:
                 result = _run_entry(entry, flat_inps)
@@ -1485,6 +1580,32 @@ def jit(
             break
         entry.stats.first_run_s = (timer_ns() - run_start) / 1e9
         cs.first_run_seconds += entry.stats.first_run_s
+        # Persistent-XLA-cache verdict of the first run: "hit" means those
+        # seconds were a deserialize, "miss" a real backend compile — the
+        # phase split that tells a cold-start regression from a cache-key
+        # change (docs/observability.md, compile-phase spans). The backend-
+        # compile and cache-retrieval sub-spans come from jax's own
+        # monitoring durations, so the wall total decomposes further.
+        jax_compile1 = _jax_cache_counts()
+        cache_verdict = None
+        if jax_compile1["misses"] > jax_compile0["misses"]:
+            cache_verdict = "miss"
+        elif jax_compile1["hits"] > jax_compile0["hits"]:
+            cache_verdict = "hit"
+        entry.stats.phases["xla_compile"] = entry.stats.first_run_s
+        if cache_verdict:
+            entry.stats.phases["persistent_cache"] = cache_verdict
+        _entry_log = getattr(cd, "_event_log", None)
+        for sub, key in (("xla_backend_compile", "backend_compile_s"),
+                         ("persistent_cache_get", "cache_get_s")):
+            delta = jax_compile1[key] - jax_compile0[key]
+            if delta > 0.0:
+                entry.stats.phases[sub] = delta
+                _record_compile_phase(entry.compile_id, sub, delta, log=_entry_log)
+        _record_compile_phase(
+            entry.compile_id, "xla_compile", entry.stats.first_run_s,
+            log=_entry_log, cache=cache_verdict,
+        )
         if obsm.enabled():
             # The entry's first run is where jax.jit actually compiles: this
             # is the end-to-end XLA compile cost per compile class — the

@@ -157,7 +157,7 @@ class EntryStats:
     """Per-cache-entry counters (ISSUE 2: cache observability)."""
 
     __slots__ = ("hits", "fast_hits", "prologue_runs", "guard_fails", "trace_s",
-                 "first_run_s", "degradation_level")
+                 "first_run_s", "degradation_level", "phases")
 
     def __init__(self):
         self.hits = 0  # times this entry served a call
@@ -170,6 +170,11 @@ class EntryStats:
         # 0 normal, 1 no fusion/donation, 2 + aggressive remat, 3 + exact
         # shapes. Surfaced per entry by thunder_tpu.cache_info.
         self.degradation_level = 0
+        # Compile-phase spans (seconds) of this entry's build: trace /
+        # transforms / claim / staging / xla_compile, plus the persistent
+        # XLA cache verdict ("persistent_cache": "hit"|"miss") when jax's
+        # cache resolved the first run. Mirrors the compile_phase events.
+        self.phases: dict = {}
 
     def as_dict(self) -> dict:
         return {k: getattr(self, k) for k in self.__slots__}
@@ -206,6 +211,10 @@ class CacheEntry:
     # to attribute the producing op (resilience/deopt.py).
     on_nan: Any = None
     claimed_extrace: Any = None
+    # The compile_scope id this entry was built under: the first run happens
+    # after the scope exits, so the xla_compile phase event needs the id
+    # carried explicitly to correlate with the build's compile_phase events.
+    compile_id: Any = None
     stats: EntryStats = field(default_factory=EntryStats)
 
 

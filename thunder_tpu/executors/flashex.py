@@ -55,6 +55,7 @@ from typing import Optional
 import numpy as np
 
 from thunder_tpu.core.proxies import TensorProxy, pyval
+from thunder_tpu.executors.jaxex import enable_x64 as jaxex_enable_x64
 from thunder_tpu.extend import OperatorExecutor, add_default_executor, register_executor
 from thunder_tpu.resilience import chaos
 
@@ -279,7 +280,7 @@ def _splash_sdpa(q, k, v, *, causal: bool, scale: float, kv_valid=None, q_valid=
     )
     qs = (q * jnp.asarray(scale, dtype=q.dtype)).astype(q.dtype)
 
-    with jax.enable_x64(False):
+    with jaxex_enable_x64(False):
         if need_seg:
             qv = jnp.ones((B, Tq), dtype=jnp.bool_) if q_valid is None else q_valid
             kvv = jnp.ones((B, Tkv), dtype=jnp.bool_) if kv_valid is None else kv_valid
@@ -439,7 +440,7 @@ def _legacy_flash(q, k, v, *, causal: bool, sm_scale: float):
     )
     # The kernel's internal index math assumes 32-bit Python-int weak types;
     # scope out the runtime's x64 mode while tracing it.
-    with jax.enable_x64(False):
+    with jaxex_enable_x64(False):
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale, block_sizes=sizes)
 
 
@@ -483,7 +484,7 @@ def _sdpa_bwd_impl(g, query, key, value, attn_mask=None, is_causal=False, scale=
         f = partial(_legacy_flash, causal=bool(is_causal), sm_scale=sm_scale)
     else:
         f = lambda q, k, v: _sdpa_runtime(q, k, v, attn_mask, bool(is_causal), sm_scale)
-    with jax.enable_x64(False):
+    with jaxex_enable_x64(False):
         _, vjp = jax.vjp(f, query, k, v)
         dq, dk, dv = vjp(g)
 
@@ -539,7 +540,7 @@ def _splash_fwd_res(q, k, v, *, causal: bool, scale: float):
         True,
     )
     qs = (q * jnp.asarray(scale, dtype=q.dtype)).astype(q.dtype)
-    with jax.enable_x64(False):
+    with jaxex_enable_x64(False):
         out, (lse,) = jax.vmap(kernel)(qs, k, v)
     return out, lse[..., :Tq].astype(jnp.float32)
 
@@ -595,7 +596,7 @@ def _sdpa_bwd_res_impl(g, query, key, value, out, lse, attn_mask=None, is_causal
         )
         return grads[3], grads[4], grads[5]
 
-    with jax.enable_x64(False):
+    with jaxex_enable_x64(False):
         dqs, dk, dv = jax.vmap(one)(qs, k, v, out, lse.astype(jnp.float32), g)
     dq = dqs.astype(jnp.float32) * sm_scale  # fwd consumed q*scale
 

@@ -20,6 +20,7 @@ from functools import partial
 from typing import Optional
 
 from thunder_tpu.core.proxies import TensorProxy, pyval
+from thunder_tpu.executors.jaxex import enable_x64 as jaxex_enable_x64
 from thunder_tpu.extend import OperatorExecutor, add_default_executor, register_executor
 from thunder_tpu.resilience import chaos
 
@@ -137,7 +138,7 @@ def _ce_call(kernel, out_lanes, out_dtype, logits, *extra):
         in_specs.append(pl.BlockSpec((bn, _LANE), lambda i: (i, 0), memory_space=pltpu.VMEM))
     # Mosaic's index maths is 32-bit; scope out the runtime's x64 mode so the
     # grid index maps don't trace to i64 (which fails to legalize).
-    with jax.enable_x64(False):
+    with jaxex_enable_x64(False):
         return pl.pallas_call(
             kernel,
             grid=grid,
@@ -240,7 +241,7 @@ def _rope_impl(x, cos, sin):
     xf = x.reshape(B * H, T, D)
     cosx = cos.astype(x.dtype)
     sinx = sin.astype(x.dtype)
-    with jax.enable_x64(False):
+    with jaxex_enable_x64(False):
         out = pl.pallas_call(
             partial(_rope_kernel, half=D // 2),
             grid=(B * H, T // bt),
@@ -347,7 +348,7 @@ def _rms_impl(a, normalized_shape, weight=None, eps=None):
     N = xf.shape[0]
     bt = _norm_bt(N, D)
     w2 = weight.reshape(1, D)
-    with jax.enable_x64(False):
+    with jaxex_enable_x64(False):
         out = pl.pallas_call(
             partial(_rms_fwd_kernel, eps=e),
             grid=(N // bt,),
@@ -375,7 +376,7 @@ def _rms_bwd_impl(g, a, weight, eps):
     N = xf.shape[0]
     bt = _norm_bt(N, D)
     w2 = weight.reshape(1, D)
-    with jax.enable_x64(False):
+    with jaxex_enable_x64(False):
         dx, dwp = pl.pallas_call(
             partial(_rms_bwd_kernel, eps=e),
             grid=(N // bt,),
@@ -456,7 +457,7 @@ def _ln_impl(a, normalized_shape, weight=None, bias=None, eps=1e-5):
     w2 = weight.reshape(1, D)
     has_bias = bias is not None
     b2 = bias.reshape(1, D) if has_bias else jnp.zeros((1, D), dtype=a.dtype)
-    with jax.enable_x64(False):
+    with jaxex_enable_x64(False):
         out = pl.pallas_call(
             partial(_ln_fwd_kernel, eps=e, has_bias=has_bias),
             grid=(N // bt,),
@@ -485,7 +486,7 @@ def _ln_bwd_impl(g, a, weight, bias, eps):
     N = xf.shape[0]
     bt = _norm_bt(N, D)
     w2 = weight.reshape(1, D)
-    with jax.enable_x64(False):
+    with jaxex_enable_x64(False):
         dx, dwp, dbp = pl.pallas_call(
             partial(_ln_bwd_kernel, eps=e),
             grid=(N // bt,),

@@ -1259,6 +1259,29 @@ class ThunderModule:
 
         flat_concrete, _ = tree_flatten(((self._params,) + args, kwargs))
         flat_inputs = [bridge.to_jax(x) if bridge.is_concrete_tensor(x) else x for x in flat_concrete]
+        if self._dist_active():
+            # A torch-bridged input commits to one device while the fsdp/ddp
+            # params live NamedSharded across the mesh, and jit refuses a
+            # computation whose committed args span different device sets.
+            # Replicate any off-mesh array onto the mesh (already-placed
+            # params pass through); the staged entry's in_specs reshard
+            # batch-sharded data from there.
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            mesh = self._dist["mesh"]
+            replicated = NamedSharding(mesh, PartitionSpec())
+            mesh_devices = set(mesh.devices.flat)
+
+            def _on_mesh(a):
+                if not isinstance(a, jax.Array):
+                    return a
+                sh = getattr(a, "sharding", None)
+                if sh is not None and set(sh.device_set) == mesh_devices:
+                    return a
+                return jax.device_put(a, replicated)
+
+            flat_inputs = [_on_mesh(x) for x in flat_inputs]
 
         if entry["bwd"] is None:
             out = _to_torch_tree(entry["fwd"](*flat_inputs))
@@ -1334,6 +1357,27 @@ def _run_thunder_function(entry: dict, flat_inputs: list, input_tensors: list, p
         @staticmethod
         def backward(ctx, *cotangents):
             cts = [bridge.to_jax(c) for c in cotangents]
+            # Torch-bridged cotangents commit to one device; under a dist
+            # config the saved tensors live on the mesh, and jit refuses
+            # mixed device sets. Replicate off-mesh cotangents onto the
+            # saved tensors' mesh (same seam as the forward inputs).
+            mesh = next(
+                (getattr(s.sharding, "mesh", None) for s in ctx.thunder_saved
+                 if isinstance(s, jax.Array)
+                 and getattr(s.sharding, "mesh", None) is not None),
+                None,
+            )
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                replicated = NamedSharding(mesh, PartitionSpec())
+                mesh_devices = set(mesh.devices.flat)
+                cts = [
+                    jax.device_put(c, replicated)
+                    if isinstance(c, jax.Array) and set(c.sharding.device_set) != mesh_devices
+                    else c
+                    for c in cts
+                ]
             grads = entry["bwd"](*ctx.thunder_saved, *cts)
             ctx.thunder_saved = None  # free eagerly (reference: :69-74)
             out_grads = []

@@ -27,9 +27,24 @@ from thunder_tpu.observability.metrics import (  # noqa: F401
 )
 
 
-def report() -> dict:
-    """Full snapshot of every registered metric (histograms summarized)."""
-    return REGISTRY.report()
+def _host_labels() -> dict:
+    """``{"host", "pid"}`` of this process — the writer identity the event
+    log already stamps (observability/events.host_identity), reused as the
+    metrics host/process dimension so logs and scrapes join on the same key."""
+    from thunder_tpu.observability.events import host_identity
+
+    ident = host_identity()
+    return {"host": str(ident["host"]), "pid": str(ident["pid"])}
+
+
+def report(include_host: bool = False) -> dict:
+    """Full snapshot of every registered metric (histograms summarized).
+    ``include_host=True`` adds the writer identity under ``"host_identity"``
+    so per-host snapshots from a fleet can be aggregated unambiguously."""
+    out = REGISTRY.report()
+    if include_host:
+        out["host_identity"] = _host_labels()
+    return out
 
 
 def report_compact() -> dict:
@@ -37,9 +52,25 @@ def report_compact() -> dict:
     return REGISTRY.report_compact()
 
 
-def prometheus_text() -> str:
-    """Prometheus text exposition format (serve it from a /metrics route)."""
-    return REGISTRY.prometheus_text()
+def prometheus_text(include_host: bool = False) -> str:
+    """Prometheus text exposition format (serve it from a /metrics route).
+    ``include_host=True`` stamps ``host=``/``pid=`` labels onto every series
+    (escaped per the exposition format) — the multi-host dimension that lets
+    one aggregator scrape a fleet of per-process /metrics routes."""
+    return REGISTRY.prometheus_text(extra_labels=_host_labels() if include_host else None)
+
+
+def host_health(source, *, spread_threshold: float = 1.5):
+    """Cross-host health over merged per-host event logs: per-host step-time
+    stats, the fleet spread ratio (gauge
+    ``thunder_tpu_host_step_time_spread_ratio``), and straggler suspects
+    (``straggler_suspect`` event + warning diagnostic per flagged host).
+    ``source`` is a list of per-host JSONL paths or already-merged records;
+    returns ``(summary, diagnostics)``. CLI spelling:
+    ``scripts/lint_traces.py --events h0.jsonl h1.jsonl ...``."""
+    from thunder_tpu.analysis.events import host_health as _hh
+
+    return _hh(source, spread_threshold=spread_threshold)
 
 
 def dump_json(path: str) -> None:

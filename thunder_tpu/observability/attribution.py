@@ -37,7 +37,7 @@ import json
 import os
 import re
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any, Optional, Sequence
 
 # Scope with provenance: L<idx>.<sym>(#|@)<pass>. Symbol names may be dotted
 # (executor ops like torch.sdpa_fwd_res).
@@ -48,6 +48,39 @@ _SCOPE_BARE_RE = re.compile(r"L(\d+)\.([A-Za-z_][\w.]*?)(?=/|$)")
 
 # Event names that are device time but not attributable work.
 _IDLE_NAMES = {"idle", "Idle", "IDLE"}
+
+# HLO collective op families: what the SPMD partitioner (or shard_map
+# lowering) names the wire ops in the compiled module. Instance names carry
+# a ".N" suffix (all-gather.3); the class is the base family name.
+_COLLECTIVE_HLO_RE = re.compile(
+    r"\b(all-gather|all-reduce|reduce-scatter|collective-permute|all-to-all|"
+    r"collective-broadcast|ragged-all-to-all)(-start|-done)?(\.\d+)?\b"
+)
+
+# Trace-level collective symbols (distributed/prims.py) → the HLO family
+# their jax lowering produces. A scoped profiler row whose sym is one of
+# these is a collective even when the event name itself is a fusion label.
+COLLECTIVE_SYM_CLASS = {
+    "all_gather": "all-gather",
+    "all_reduce": "all-reduce",
+    "reduce_scatter": "reduce-scatter",
+    "broadcast": "all-reduce",  # lowered as masked psum (dist_prims)
+    "all_to_all": "all-to-all",
+    "ppermute": "collective-permute",
+    "synchronize": "all-gather",  # fsdp gather; replicated sync is a no-op
+}
+
+
+def collective_class(name: str, hlo_op: str = "", refs: Sequence["ScopeRef"] = ()) -> Optional[str]:
+    """The collective family of a profiler row ("all-gather", "all-reduce",
+    ...), or None for compute rows. Classified by the trace-level symbol
+    when the row carries a scope, else by the HLO op/event name."""
+    for ref in refs:
+        cls = COLLECTIVE_SYM_CLASS.get(ref.sym)
+        if cls is not None:
+            return cls
+    m = _COLLECTIVE_HLO_RE.search(hlo_op) or _COLLECTIVE_HLO_RE.search(name)
+    return m.group(1) if m else None
 
 
 @dataclass(frozen=True)
@@ -124,6 +157,28 @@ def load_trace_events(path: str) -> list[dict]:
 
 
 @dataclass
+class CollectiveRow:
+    """Measured device time of one collective (one scoped trace line, or one
+    HLO collective instance when the partitioner inserted it), with the
+    portion of its wall interval hidden under concurrent compute on the same
+    device vs. exposed on that device's critical path."""
+
+    key: str  # scope label (L<i>.<sym>) or HLO instance name (all-gather.3)
+    cls: str  # collective family: all-gather | all-reduce | ...
+    us: float = 0.0  # total device time across calls
+    hidden_us: float = 0.0  # overlapped with compute on another lane of the device
+    count: int = 0
+
+    @property
+    def exposed_us(self) -> float:
+        return max(0.0, self.us - self.hidden_us)
+
+    @property
+    def hidden_frac(self) -> float:
+        return self.hidden_us / self.us if self.us else 0.0
+
+
+@dataclass
 class Attribution:
     """Measured device time aggregated per trace line / symbol / pass."""
 
@@ -133,6 +188,7 @@ class Attribution:
     by_pass: dict[str, float] = field(default_factory=dict)
     fusions: dict[str, tuple[float, tuple[ScopeRef, ...]]] = field(default_factory=dict)
     unattributed: dict[str, float] = field(default_factory=dict)  # op name -> us
+    collectives: dict[str, CollectiveRow] = field(default_factory=dict)  # key -> row
     device_busy_us: float = 0.0  # non-idle device time
     idle_us: float = 0.0
     files: list[str] = field(default_factory=list)
@@ -149,6 +205,25 @@ class Attribution:
     @property
     def with_provenance_us(self) -> float:
         return sum(us for ref, us in self.by_line.items() if ref.pass_name)
+
+    @property
+    def collective_us(self) -> float:
+        """Total measured device time spent in collective rows."""
+        return sum(r.us for r in self.collectives.values())
+
+    @property
+    def exposed_collective_us(self) -> float:
+        return sum(r.exposed_us for r in self.collectives.values())
+
+    def collective_summary(self) -> dict[str, CollectiveRow]:
+        """Per-family rollup of the per-instance collective rows."""
+        out: dict[str, CollectiveRow] = {}
+        for row in self.collectives.values():
+            agg = out.setdefault(row.cls, CollectiveRow(key=row.cls, cls=row.cls))
+            agg.us += row.us
+            agg.hidden_us += row.hidden_us
+            agg.count += row.count
+        return out
 
     def top(self, k: int = 10) -> list[tuple[ScopeRef, float]]:
         return sorted(self.by_line.items(), key=lambda kv: -kv[1])[:k]
@@ -171,6 +246,16 @@ class Attribution:
             lines.append("  unattributed: " + ", ".join(f"{n}={us:.0f}us" for n, us in worst))
         if self.fusions:
             lines.append(f"  fusion groups spanning several lines: {len(self.fusions)}")
+        if self.collectives:
+            lines.append(
+                f"  collectives: {self.collective_us:.1f}us on the wire, "
+                f"{self.exposed_collective_us:.1f}us exposed ("
+                + ", ".join(
+                    f"{cls}={r.us:.0f}us/{r.hidden_frac * 100:.0f}%hidden"
+                    for cls, r in sorted(self.collective_summary().items())
+                )
+                + ")"
+            )
         return "\n".join(lines)
 
     def __str__(self) -> str:
@@ -210,6 +295,140 @@ def _self_times(device_ops: list[dict]) -> dict[int, float]:
                 out[stack[-1][1]] -= dur  # direct parent loses this child's span
             stack.append((ts + dur, id(ev)))
     return out
+
+
+def _lane_segments(evs: list[dict]) -> list[tuple[float, float, dict]]:
+    """Leaf-level ``(start, end, event)`` segments of one serial timeline
+    (one ``(pid, tid)`` lane): at any instant the deepest open event owns the
+    moment, so a ``call`` wrapper's interval is split around its children
+    instead of double-covering them — the interval analogue of
+    :func:`_self_times`."""
+    segs: list[tuple[float, float, dict]] = []
+    stack: list[list] = []  # [end_ts, event, cursor]
+    eps = 1e-6
+
+    def close(upto: float) -> None:
+        while stack and stack[-1][0] <= upto + eps:
+            end, ev, cur = stack.pop()
+            if end > cur:
+                segs.append((cur, end, ev))
+
+    for ev in sorted(evs, key=lambda e: (float(e.get("ts", 0.0)), -float(e.get("dur", 0.0)))):
+        ts = float(ev.get("ts", 0.0))
+        dur = float(ev.get("dur", 0.0))
+        close(ts)
+        if stack:
+            parent = stack[-1]
+            if ts > parent[2]:
+                segs.append((parent[2], ts, parent[1]))
+            parent[2] = ts + dur  # parent resumes after this child
+        stack.append([ts + dur, ev, ts])
+    close(float("inf"))
+    return segs
+
+
+def _merge_intervals(iv: list[tuple[float, float]]) -> list[tuple[float, float]]:
+    if not iv:
+        return []
+    iv = sorted(iv)
+    out = [list(iv[0])]
+    for a, b in iv[1:]:
+        if a <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], b)
+        else:
+            out.append([a, b])
+    return [(a, b) for a, b in out]
+
+
+def _overlap_us(start: float, end: float, merged: list[tuple[float, float]]) -> float:
+    """Length of ``[start, end]`` covered by the merged interval union."""
+    total = 0.0
+    for a, b in merged:
+        if b <= start:
+            continue
+        if a >= end:
+            break
+        total += min(b, end) - max(a, start)
+    return total
+
+
+def _collect_overlap(
+    attr: Attribution,
+    device_ops: list[dict],
+    process_names: dict,
+    op_refs: dict[int, list[ScopeRef]],
+) -> None:
+    """Per-collective hidden/exposed split for one trace file's device ops.
+
+    Lanes (``(pid, tid)`` timelines) are grouped into devices: a pid whose
+    process name is a device (``/device:TPU:N``) owns all its lanes (the
+    TensorCore/DMA/stream lines xprof draws per core); host pids (the CPU
+    plugin puts every emulated device's thread under one pid) count each
+    lane as its own device. A collective's hidden time is the part of its
+    wall interval covered by *compute* leaf segments on another lane of the
+    same device — compute on a different device concurrently is parallelism,
+    not overlap, and a lane is serial so same-lane overlap cannot exist.
+    On backends with no async collective lanes (CPU) hidden is therefore
+    structurally 0: every collective microsecond is exposed, which is the
+    correct before-picture for overlap work."""
+    by_lane: dict[tuple, list[dict]] = {}
+    for ev in device_ops:
+        by_lane.setdefault((ev.get("pid"), ev.get("tid")), []).append(ev)
+
+    def device_of(lane: tuple) -> tuple:
+        pid = lane[0]
+        return (pid,) if _is_device_pid(process_names, pid) else lane
+
+    compute_by_device: dict[tuple, list[tuple[float, float, tuple]]] = {}
+    collective_evs: list[tuple[dict, str, tuple]] = []  # (ev, cls, lane)
+    for lane, evs in by_lane.items():
+        dev = device_of(lane)
+        for start, end, ev in _lane_segments(evs):
+            name = str(ev.get("name", ""))
+            args = ev.get("args") if isinstance(ev.get("args"), dict) else {}
+            hlo_op = str(args.get("hlo_op", "")) if args else ""
+            if name in _IDLE_NAMES or hlo_op in _IDLE_NAMES:
+                continue
+            if collective_class(name, hlo_op, op_refs.get(id(ev), ())) is None:
+                compute_by_device.setdefault(dev, []).append((start, end, lane))
+        for ev in evs:
+            name = str(ev.get("name", ""))
+            args = ev.get("args") if isinstance(ev.get("args"), dict) else {}
+            hlo_op = str(args.get("hlo_op", "")) if args else ""
+            cls = collective_class(name, hlo_op, op_refs.get(id(ev), ()))
+            if cls is not None and float(ev.get("dur", 0.0)) > 0.0:
+                collective_evs.append((ev, cls, lane))
+
+    # The merged other-lane compute union depends only on (device, lane):
+    # build it once per lane, not once per collective event (a multi-step
+    # trace has thousands of collective instances over a handful of lanes).
+    merged_cache: dict[tuple, list[tuple[float, float]]] = {}
+
+    def other_lane_compute(dev: tuple, lane: tuple) -> list[tuple[float, float]]:
+        key = (dev, lane)
+        got = merged_cache.get(key)
+        if got is None:
+            got = merged_cache[key] = _merge_intervals([
+                (s, e) for s, e, seg_lane in compute_by_device.get(dev, ())
+                if seg_lane != lane
+            ])
+        return got
+
+    for ev, cls, lane in collective_evs:
+        dev = device_of(lane)
+        other = other_lane_compute(dev, lane)
+        ts = float(ev.get("ts", 0.0))
+        dur = float(ev.get("dur", 0.0))
+        hidden = _overlap_us(ts, ts + dur, other)
+        refs = op_refs.get(id(ev), ())
+        args = ev.get("args") if isinstance(ev.get("args"), dict) else {}
+        key = refs[0].label if refs else (
+            str(args.get("hlo_op")) if args and args.get("hlo_op") else str(ev.get("name", cls))
+        )
+        row = attr.collectives.setdefault(key, CollectiveRow(key=key, cls=cls))
+        row.us += dur
+        row.hidden_us += min(hidden, dur)
+        row.count += 1
 
 
 def _is_device_op(ev: dict, process_names: dict, thread_names: dict) -> bool:
@@ -268,6 +487,27 @@ def attribute(
                     thread_names[(ev.get("pid"), ev.get("tid"))] = ev.get("args", {}).get("name", "")
         device_ops = [ev for ev in events if _is_device_op(ev, process_names, thread_names)]
         self_us = _self_times(device_ops)
+        # Scope source, in order: the event name (TPU op rows carry the
+        # full metadata path), then each arg value on its own (xprof
+        # puts fused long names in args; parsing per-string keeps the
+        # bare-scope regex's end-of-string anchor working for truncated
+        # legacy names), then the HLO-text join on hlo_op/name. Resolved
+        # once per event: the overlap pass classifies collectives by the
+        # same refs the time attribution charges.
+        op_refs: dict[int, list[ScopeRef]] = {}
+        for ev in device_ops:
+            name = str(ev.get("name", ""))
+            args = ev.get("args") if isinstance(ev.get("args"), dict) else {}
+            hlo_op = str(args.get("hlo_op", "")) if args else ""
+            refs = parse_scopes(name)
+            if not refs and args:
+                for v in args.values():
+                    refs.extend(parse_scopes(str(v)))
+            if not refs and scope_map:
+                mapped = scope_map.get(hlo_op) or scope_map.get(name)
+                if mapped:
+                    refs = parse_scopes(mapped)
+            op_refs[id(ev)] = refs
         for ev in device_ops:
             name = str(ev.get("name", ""))
             dur = self_us[id(ev)]
@@ -279,19 +519,7 @@ def attribute(
                 attr.idle_us += dur
                 continue
             attr.device_busy_us += dur
-            # Scope source, in order: the event name (TPU op rows carry the
-            # full metadata path), then each arg value on its own (xprof
-            # puts fused long names in args; parsing per-string keeps the
-            # bare-scope regex's end-of-string anchor working for truncated
-            # legacy names), then the HLO-text join on hlo_op/name.
-            refs = parse_scopes(name)
-            if not refs and args:
-                for v in args.values():
-                    refs.extend(parse_scopes(str(v)))
-            if not refs and scope_map:
-                mapped = scope_map.get(hlo_op) or scope_map.get(name)
-                if mapped:
-                    refs = parse_scopes(mapped)
+            refs = op_refs[id(ev)]
             if not refs:
                 key = hlo_op or name
                 attr.unattributed[key] = attr.unattributed.get(key, 0.0) + dur
@@ -306,6 +534,9 @@ def attribute(
             if len(refs) > 1:
                 prev = attr.fusions.get(name, (0.0, tuple(refs)))
                 attr.fusions[name] = (prev[0] + dur, tuple(refs))
+        # Compute–comm overlap: per collective, how much of its wall
+        # interval was hidden under compute on another lane of its device.
+        _collect_overlap(attr, device_ops, process_names, op_refs)
     return attr
 
 
@@ -358,6 +589,22 @@ class JoinedRow:
 
 
 @dataclass
+class CollectiveJoin:
+    """One collective family (or scoped collective line) joined across the
+    predicted and measured halves: ring-factor wire-time bound from the cost
+    model vs. measured device time split into hidden (overlapped with
+    compute) and exposed (on the device critical path)."""
+
+    key: str
+    cls: str
+    count: int
+    us: float  # measured, per step
+    hidden_us: float
+    exposed_us: float
+    predicted_wire_us: Optional[float] = None  # cost-model bound, per step
+
+
+@dataclass
 class PerfJoin:
     """The joined report: top-k measured ops annotated with predicted
     cost, roofline ratio, and boundedness; plus trace-level rollups."""
@@ -369,6 +616,7 @@ class PerfJoin:
     measured_step_us: float = 0.0
     mfu: Optional[float] = None
     padding_waste_elements: Optional[float] = None
+    collectives: list[CollectiveJoin] = field(default_factory=list)
 
     def format(self, top_k: int = 10) -> str:
         a = self.attribution
@@ -402,10 +650,72 @@ class PerfJoin:
             worst = sorted(a.unattributed.items(), key=lambda kv: -kv[1])[:3]
             lines.append("  unattributed: " + ", ".join(
                 f"{n}={us / self.steps:.0f}us" for n, us in worst))
+        if self.collectives:
+            lines.append("  compute-comm overlap (per collective, us/step):")
+            lines.append(
+                f"  {'collective':<28} {'n':>4} {'measured':>9} {'hidden':>8} "
+                f"{'exposed':>8} {'predicted':>10}"
+            )
+            for c in self.collectives:
+                pred = f"{c.predicted_wire_us:.1f}" if c.predicted_wire_us is not None else "-"
+                lines.append(
+                    f"  {c.key:<28.28} {c.count:>4} {c.us:>9.1f} {c.hidden_us:>8.1f} "
+                    f"{c.exposed_us:>8.1f} {pred:>10}"
+                )
         return "\n".join(lines)
 
     def __str__(self) -> str:
         return self.format()
+
+
+def _join_collectives(attr: Attribution, cost: Optional[Any], steps: int) -> list[CollectiveJoin]:
+    """Measured collective rows (scaled to per-step) joined with the cost
+    model's ring-factor wire-time bounds.
+
+    Scoped rows (trace-level dist_prims collectives, ``L<i>.<sym>``) join
+    their cost row by (line, sym); partitioner-inserted collectives carry no
+    scope, so those join at the family level — measured family totals against
+    the summed predicted wire time of the trace's collectives in that family
+    (``COLLECTIVE_SYM_CLASS`` maps sym → HLO family)."""
+    if not attr.collectives:
+        return []
+    cost_by_line: dict[tuple[int, str], float] = {}
+    cost_by_cls: dict[str, float] = {}
+    if cost is not None and getattr(cost.device, "ici_bw", 0.0):
+        ici_bw = cost.device.ici_bw
+        for r in cost.rows:
+            if r.kind != "collective" or not r.comm_bytes:
+                continue
+            wire_us = r.comm_bytes / ici_bw * 1e6
+            cost_by_line[(r.index, r.sym)] = cost_by_line.get((r.index, r.sym), 0.0) + wire_us
+            cls = COLLECTIVE_SYM_CLASS.get(r.sym)
+            if cls is not None:
+                cost_by_cls[cls] = cost_by_cls.get(cls, 0.0) + wire_us
+
+    out: list[CollectiveJoin] = []
+    scoped = {k: v for k, v in attr.collectives.items() if parse_scope(k) is not None}
+    unscoped = {k: v for k, v in attr.collectives.items() if k not in scoped}
+    for key, row in sorted(scoped.items(), key=lambda kv: -kv[1].us):
+        ref = parse_scope(key)
+        out.append(CollectiveJoin(
+            key=key, cls=row.cls, count=row.count, us=row.us / steps,
+            hidden_us=row.hidden_us / steps, exposed_us=row.exposed_us / steps,
+            predicted_wire_us=cost_by_line.get((ref.line, ref.sym)),
+        ))
+    # Family rollup of the unscoped (partitioner-inserted) instances.
+    by_cls: dict[str, CollectiveRow] = {}
+    for row in unscoped.values():
+        agg = by_cls.setdefault(row.cls, CollectiveRow(key=row.cls, cls=row.cls))
+        agg.us += row.us
+        agg.hidden_us += row.hidden_us
+        agg.count += row.count
+    for cls, row in sorted(by_cls.items(), key=lambda kv: -kv[1].us):
+        out.append(CollectiveJoin(
+            key=cls, cls=cls, count=row.count, us=row.us / steps,
+            hidden_us=row.hidden_us / steps, exposed_us=row.exposed_us / steps,
+            predicted_wire_us=cost_by_cls.get(cls) if not scoped else None,
+        ))
+    return out
 
 
 def join_cost_attribution(
@@ -453,6 +763,7 @@ def join_cost_attribution(
         rows=rows, attribution=attr, cost=cost, steps=steps,
         measured_step_us=attr.device_busy_us / steps,
     )
+    join.collectives = _join_collectives(attr, cost, steps)
     if cost is not None and attr.device_busy_us:
         join.mfu = cost.mfu_at(attr.device_busy_us / steps / 1e6)
     try:

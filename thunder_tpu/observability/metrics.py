@@ -59,6 +59,19 @@ def _label_str(key: tuple) -> str:
     return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
 
 
+def _escape_label_value(v: Any) -> str:
+    """Prometheus text-exposition escaping for label values: backslash,
+    double quote, and newline must be escaped or the scrape line is
+    malformed (host/process labels carry hostnames — arbitrary strings)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str_prom(key: tuple) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key) + "}"
+
+
 class _Metric:
     kind = "untyped"
     __slots__ = ("name", "help", "_values")
@@ -244,28 +257,36 @@ class MetricsRegistry:
                     out[f"{name}{_label_str(k)}"] = m._values.get(k)
         return out
 
-    def prometheus_text(self) -> str:
-        """Prometheus text exposition format (histograms as _bucket/_sum/_count)."""
+    def prometheus_text(self, extra_labels: Optional[dict] = None) -> str:
+        """Prometheus text exposition format (histograms as _bucket/_sum/_count).
+
+        ``extra_labels`` are merged into every series — the host/process
+        dimension for multi-host scrapes (``monitor.prometheus_text(
+        include_host=True)`` passes ``{"host": ..., "pid": ...}``), so one
+        aggregator can tell the writers of a fleet apart. Label values are
+        escaped per the exposition format."""
+        extra = dict(extra_labels) if extra_labels else {}
         lines: list[str] = []
         for name, m in sorted(self._metrics.items()):
             if m.help:
                 lines.append(f"# HELP {name} {m.help}")
             lines.append(f"# TYPE {name} {m.kind}")
             for k in list(m._values):
+                base = dict(extra, **dict(k))
+                lk = _label_str_prom(_label_key(base))
                 if isinstance(m, Histogram):
                     s = m._values.get(k)
                     if s is None:
                         continue
-                    base = dict(k)
                     for le, c in zip(m.buckets, m._cumulative(s["raw_buckets"])):
-                        lk = _label_str(_label_key(dict(base, le=repr(le))))
-                        lines.append(f"{name}_bucket{lk} {c}")
-                    lk = _label_str(_label_key(dict(base, le="+Inf")))
-                    lines.append(f"{name}_bucket{lk} {s['count']}")
-                    lines.append(f"{name}_sum{_label_str(k)} {s['sum']}")
-                    lines.append(f"{name}_count{_label_str(k)} {s['count']}")
+                        blk = _label_str_prom(_label_key(dict(base, le=repr(le))))
+                        lines.append(f"{name}_bucket{blk} {c}")
+                    blk = _label_str_prom(_label_key(dict(base, le="+Inf")))
+                    lines.append(f"{name}_bucket{blk} {s['count']}")
+                    lines.append(f"{name}_sum{lk} {s['sum']}")
+                    lines.append(f"{name}_count{lk} {s['count']}")
                 else:
-                    lines.append(f"{name}{_label_str(k)} {m._values.get(k)}")
+                    lines.append(f"{name}{lk} {m._values.get(k)}")
         return "\n".join(lines) + "\n"
 
     def dump_json(self, path: str) -> None:
@@ -342,6 +363,29 @@ INSTRUMENTED_OP_US = REGISTRY.histogram(
 DEVICE_MEM_HIGH_WATER = REGISTRY.gauge(
     "thunder_tpu_device_mem_high_water_bytes",
     "Peak device memory observed by the MemoryHighWater hook",
+)
+
+# -- distributed observatory (docs/observability.md "distributed telemetry") --
+
+# The opaque total XLA_COMPILE_S records, decomposed: trace/claim/staging/
+# backend-compile/persistent-cache spans per compile, labelled by phase —
+# the histogram the compile_phase events aggregate into.
+COMPILE_PHASE_S = REGISTRY.histogram(
+    "thunder_tpu_compile_phase_s",
+    "Compile pipeline phase duration in seconds, labelled phase=trace|transforms|"
+    "claim|staging|xla_compile (cache=hit|miss when the persistent cache resolved it)",
+    buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0),
+)
+# Cross-host health (analysis/events.host_health over merged per-host logs):
+# per-host mean step seconds, and the fleet spread ratio whose growth is the
+# straggler signal (1.0 = perfectly even).
+HOST_STEP_TIME_S = REGISTRY.gauge(
+    "thunder_tpu_host_step_time_s",
+    "Mean training-step seconds per host from merged step_time events, labelled by host",
+)
+HOST_STEP_SPREAD = REGISTRY.gauge(
+    "thunder_tpu_host_step_time_spread_ratio",
+    "Slowest host mean step time over fleet median (straggler suspect when above threshold)",
 )
 
 # -- resilience (thunder_tpu/resilience; docs/robustness.md) -------------------

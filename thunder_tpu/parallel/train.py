@@ -105,16 +105,22 @@ def build_train_step(
     donate: bool = True,
     executors=None,
     optimizer: str = "adamw",
+    return_extrace: bool = False,
 ):
     """Compile one full training step (fw+bw+AdamW) as a single sharded XLA
     executable. Returns ``(step_fn, opt_state)``;
     ``step_fn(params, opt_state, idx, targets) -> (params, opt_state, loss)``.
+
+    ``return_extrace=True`` appends the claimed joint execution trace to the
+    return tuple — the cost-model input for multichip MFU accounting
+    (``scripts/bench_multichip.py`` prices its FLOPs/collectives against the
+    device spec via ``analysis.cost.trace_cost``).
     """
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec
 
-    loss_and_grads, _ = _compile_loss_and_grads(config, params, idx, targets, executors=executors)
+    loss_and_grads, extrace = _compile_loss_and_grads(config, params, idx, targets, executors=executors)
 
     def step(params, opt_state, idx, targets):
         flat, _ = tree_flatten(((params, idx, targets), {}))
@@ -140,7 +146,7 @@ def build_train_step(
 
     if mesh is None:
         jfn = jax.jit(step, donate_argnums=(0, 1) if donate else ())
-        return jfn, opt_state
+        return (jfn, opt_state, extrace) if return_extrace else (jfn, opt_state)
 
     from thunder_tpu.parallel.sharding import data_spec as _dspec
 
@@ -162,4 +168,4 @@ def build_train_step(
         out_shardings=(param_sh, opt_sh, loss_sh),
         donate_argnums=(0, 1) if donate else (),
     )
-    return jfn, opt_state
+    return (jfn, opt_state, extrace) if return_extrace else (jfn, opt_state)

@@ -356,8 +356,14 @@ def run_training(
                     state, step, rng_seed=api._global_rng["seed"]
                 )
                 raise Preempted(step, path)
+            t0 = time.perf_counter()
             state, loss = step_fn(state)
             losses.append(loss)
+            # One step_time event per training step per host: the per-host
+            # logs of a multi-host job merge into the cross-host health
+            # summary (analysis/events.host_health — straggler detection).
+            obs_events.emit_event("step_time", fn=getattr(step_fn, "__name__", "step"),
+                                   step=step, s=round(time.perf_counter() - t0, 6))
             if on_loss is not None:
                 on_loss(step, loss)
             done = step + 1
