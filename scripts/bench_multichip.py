@@ -140,6 +140,50 @@ def bench_fsdp_tp(args, result: dict) -> None:
     strict_s = (time.perf_counter() - t0) / n_sync
     assert np.isfinite(loss_last), loss_last
 
+    if args.resilience_overhead:
+        # Steady-state cost of the mesh-wide fault-tolerance layer (ISSUE 9):
+        # each guarded dispatch runs under the collective watchdog (worker
+        # thread + join) and the step's new state through the SDC
+        # replica-checksum guard. Target <2% at production step times;
+        # docs/robustness.md documents the knobs (check_every amortizes the
+        # checksum; fully-sharded leaves cost nothing).
+        from thunder_tpu.resilience.watchdog import SDCGuard, guard_call
+
+        med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+        guard = SDCGuard(check_every=1)
+        # The guard's added work is strictly additive to a step (the
+        # watchdog adds one worker-thread spawn+join per dispatch; the SDC
+        # check runs on the host after the step syncs), so each component
+        # is measured DIRECTLY and the overhead derived over the median
+        # guarded step — an emulated CPU mesh's steps jitter ±50% under a
+        # shared scheduler, which drowns any loop-vs-loop delta of a
+        # percent-scale cost (the failed protocol r02 replaced).
+        plain, checks = [], []
+        for _ in range(max(6, n_sync)):
+            t0 = time.perf_counter()
+            p, o, loss = guard_call(step, (p, o, idx, tgt),
+                                    fn_name="train_step", timeout_s=120.0)
+            loss.block_until_ready()
+            tc = time.perf_counter()
+            plain.append(tc - t0)
+            guard.check_state((p, o))
+            checks.append(time.perf_counter() - tc)
+        spawn = []
+        noop = lambda: None  # noqa: E731
+        for _ in range(50):
+            t0 = time.perf_counter()
+            guard_call(noop, (), fn_name="noop", timeout_s=120.0)
+            spawn.append(time.perf_counter() - t0)
+        step_s, check_s, spawn_s = med(plain), med(checks), med(spawn)
+        overhead_pct = ((check_s + spawn_s) / step_s * 100.0) if step_s else 0.0
+        result["resilience_iter_s"] = round(step_s + check_s + spawn_s, 4)
+        result["resilience_overhead_pct"] = round(overhead_pct, 2)
+        result["sdc_check_us_per_step"] = round(check_s * 1e6, 1)
+        result["watchdog_dispatch_us"] = round(spawn_s * 1e6, 1)
+        _log(f"resilience overhead: sdc check {check_s * 1e6:.0f}us + watchdog "
+             f"{spawn_s * 1e6:.0f}us over a {step_s * 1e3:.1f}ms median step "
+             f"= {overhead_pct:+.2f}%")
+
     # Aggregate MFU: the traced program computes the GLOBAL batch, so its
     # FLOPs divide across every chip — MFU is flops / (t · n · per-chip peak).
     spec = resolve_device_spec(args.device_spec)
@@ -282,6 +326,7 @@ def bench_overlap(args, result: dict) -> None:
         return
     hlo_text = None
     try:
+        # The watchdog wrapper around the jitted fn delegates lower/compile.
         if hasattr(jf, "lower"):
             hlo_text = jf.lower(*flat).compile().as_text()
     except Exception:
@@ -340,6 +385,9 @@ def main(argv=None) -> int:
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--profile-steps", type=int, default=3)
     p.add_argument("--no-profile", action="store_true")
+    p.add_argument("--resilience-overhead", action="store_true",
+                   help="also measure watchdog+SDC-guard steady-state step "
+                        "overhead vs the strict protocol (ISSUE 9; target <2%%)")
     p.add_argument("--device-spec", default=None,
                    help="cost-model device spec (default: autodetect)")
     p.add_argument("--out", default=None, help="also write the JSON to this path")

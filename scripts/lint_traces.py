@@ -30,6 +30,15 @@ Usage:
         # failure, OOM, NaN poison) and fail on any unrecovered fault,
         # non-baseline-equal recovery, or missing degradation event in the
         # JSONL log (replayed through the correlation rule)
+    python scripts/lint_traces.py --chaos-multihost
+        # mesh-wide resilience smoke (ISSUE 9): the FSDP×TP training step
+        # on a virtual 8-device mesh under a canned host-loss +
+        # collective-hang + SDC schedule — collective hang must raise the
+        # typed watchdog timeout naming trace line + suspected host,
+        # host loss must checkpoint and elastically resume on the shrunk
+        # fsdp2·tp2 mesh reproducing the uninterrupted loss trajectory,
+        # SDC must be caught by the replica-checksum guard and re-run;
+        # every fault_injected needs its paired recovery event
 """
 
 from __future__ import annotations
@@ -328,12 +337,220 @@ def _chaos_smoke() -> int:
     return n_errors
 
 
-_USAGE = ("usage: lint_traces.py [pattern] | --chaos | --multichip | "
-          "--events <log.jsonl> [...] [--storm-threshold N]")
+def _chaos_multihost_smoke() -> int:
+    """--chaos-multihost: re-exec this script on a virtual 8-device CPU mesh
+    (the device-count flag must be set before jax initializes) and run
+    :func:`_chaos_multihost_inner` there. Returns the error count."""
+    import subprocess
+
+    env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": os.environ.get("HOME", "/root"),
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "THUNDER_TPU_RETRY_BACKOFF_S": "0",
+    }
+    cmd = [sys.executable, os.path.abspath(__file__), "--_chaos-multihost-inner"]
+    print("--- chaos-multihost smoke (subprocess, 8 virtual devices)")
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True, timeout=1200)
+    out = (r.stdout + r.stderr).strip().splitlines()
+    for line in out[-40:]:
+        print(f"    {line}")
+    if r.returncode != 0:
+        print(f"    FAILED: inner smoke exited {r.returncode}")
+        return 1
+    return 0
+
+
+def _chaos_multihost_inner() -> int:
+    """The mesh-wide chaos matrix (ISSUE 9 acceptance), run with 8 virtual
+    devices: collective-hang → typed watchdog timeout naming trace line +
+    suspected host; host-loss-at-step → checkpoint agreement → elastic
+    resume on the shrunk mesh reproducing the uninterrupted loss
+    trajectory; SDC injection → replica-checksum divergence → quarantine +
+    re-run; all with paired fault_injected/recovery events validated by the
+    replay correlation rule."""
+    import json
+    import tempfile
+
+    import numpy as np
+
+    import thunder_tpu.monitor as monitor
+    from thunder_tpu.analysis import Severity
+    from thunder_tpu.analysis.events import format_replay, replay_events
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.parallel import build_train_step, make_mesh
+    from thunder_tpu.parallel.sharding import gpt_param_specs
+    from thunder_tpu.parallel.train import opt_state_specs
+    from thunder_tpu.resilience import chaos, elastic, watchdog
+    from thunder_tpu.resilience.preemption import CheckpointManager, HostLost, run_training
+
+    tmp = tempfile.mkdtemp(prefix="ttpu_mc_chaos_")
+    log = os.path.join(tmp, "events.jsonl")
+    monitor.set_event_log(log)
+    n_errors = 0
+    N_STEPS = 5
+    LOSS_STEP = 2
+
+    cfg = m.name_to_config("gpt-tiny")
+    params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+
+    def build(mesh):
+        specs = gpt_param_specs(cfg, mesh)
+        step, opt0 = build_train_step(
+            cfg, params, idx, tgt, mesh=mesh, param_specs=specs, lr=1e-2,
+            executors=["jax"], donate=False,
+        )
+
+        def step_fn(state):
+            p, o = state
+            p, o, loss = step(p, o, idx, tgt)
+            return (p, o), float(np.asarray(loss))
+
+        return step_fn, opt0, specs
+
+    mesh8 = make_mesh(fsdp=4, tp=2)
+    step8, opt0, specs8 = build(mesh8)
+    state0 = (params, opt0)
+
+    print("--- chaos-multihost: un-faulted baseline trajectory")
+    _, baseline = run_training(
+        step8, state0, N_STEPS, manager=CheckpointManager(os.path.join(tmp, "base"))
+    )
+    print(f"    losses: {['%.4f' % x for x in baseline]}")
+
+    print("--- chaos-multihost: collective hang -> typed watchdog timeout")
+    # Join against PR 8's straggler data: host_health over synthetic per-host
+    # step logs flags host 3; the timeout error must name it.
+    hl = []
+    for host in range(4):
+        p = os.path.join(tmp, f"host{host}.jsonl")
+        with open(p, "w") as f:
+            for s in range(4):
+                t = 0.4 if host == 3 else 0.1
+                f.write(json.dumps({"v": 1, "ts": float(s), "seq": s, "pid": 1,
+                                    "host": host, "kind": "step_time",
+                                    "fn": "step", "step": s, "s": t}) + "\n")
+        hl.append(p)
+    summary, _ = monitor.host_health(hl)
+    from thunder_tpu.distributed.runtime import compile_with_collectives
+    from jax.sharding import PartitionSpec as P
+
+    meshf = make_mesh(fsdp=8)
+    w = rng.randn(16, 8).astype(np.float32) * 0.1
+    x = rng.randn(4, 8).astype(np.float32)
+
+    def loss_traced(w_shard, x):
+        from thunder_tpu.distributed import prims as dist
+        import thunder_tpu.clang as clang
+
+        w_full = dist.synchronize(w_shard, "fsdp", 8, "fsdp")
+        h = clang.matmul(x, clang.transpose(w_full, 0, 1))
+        return clang.mean(clang.mul(h, h))
+
+    jf, extrace = compile_with_collectives(
+        loss_traced, (w[:2], x), meshf, (P("fsdp", None), P()),
+        (P(), (P("fsdp", None), P())), grad=True,
+    )
+    watchdog.configure(0.25)
+    try:
+        with chaos.chaos_scope("collective_hang~5.0"):
+            jf(w, x)
+        n_errors += 1
+        print("    FAILED: hang did not time out")
+    except watchdog.CollectiveTimeoutError as e:
+        ok_line = any("synchronize" in ln for ln in e.trace_lines)
+        ok_host = e.suspected_host == summary["stragglers"][0]
+        if ok_line and ok_host:
+            print(f"    typed timeout OK: lines={e.trace_lines[:2]} "
+                  f"suspect=host{e.suspected_host}")
+        else:
+            n_errors += 1
+            print(f"    FAILED: lines={e.trace_lines} suspect={e.suspected_host}")
+    finally:
+        watchdog.configure(None)
+
+    print("--- chaos-multihost: host loss -> checkpoint -> elastic resume (fsdp2-tp2)")
+    mgr = CheckpointManager(os.path.join(tmp, "elastic"))
+    try:
+        with chaos.chaos_scope(f"host_loss@{LOSS_STEP}"):
+            run_training(step8, state0, N_STEPS, manager=mgr, mesh=mesh8)
+        n_errors += 1
+        print("    FAILED: host loss did not fire")
+    except HostLost as e:
+        mesh4 = make_mesh(fsdp=2, tp=2)
+        step4, _, specs4 = build(mesh4)
+        st, start = elastic.elastic_resume(
+            mgr, state0, mesh=mesh4, specs=(specs4, opt_state_specs(specs4))
+        )
+        if start != LOSS_STEP:
+            n_errors += 1
+            print(f"    FAILED: resumed at {start}, expected {LOSS_STEP}")
+        cont = []
+        state = st
+        for _ in range(start, N_STEPS):
+            state, loss = step4(state)
+            cont.append(loss)
+        if np.allclose(cont, baseline[LOSS_STEP:], rtol=1e-5):
+            print(f"    elastic resume OK: {['%.4f' % x for x in cont]} matches "
+                  f"the uninterrupted trajectory (reduction-order tolerance)")
+        else:
+            n_errors += 1
+            print(f"    FAILED: resumed trajectory {cont} != baseline "
+                  f"{baseline[LOSS_STEP:]}")
+
+    print("--- chaos-multihost: SDC injection -> checksum guard -> re-run")
+    try:
+        with chaos.chaos_scope("sdc*1"):
+            _, sdc_losses = run_training(
+                step8, state0, N_STEPS,
+                manager=CheckpointManager(os.path.join(tmp, "sdc")),
+                sdc_guard=True,
+            )
+        if sdc_losses == baseline:
+            print("    SDC quarantine + re-run OK: trajectory bitwise-equal")
+        else:
+            n_errors += 1
+            print(f"    FAILED: SDC trajectory {sdc_losses} != {baseline}")
+    except Exception as e:
+        n_errors += 1
+        print(f"    FAILED: {type(e).__name__}: {e}")
+
+    print("--- chaos-multihost: event-log replay (correlation rule)")
+    summary, diags = replay_events(log, storm_threshold=16)
+    print(format_replay(summary, diags))
+    n_errors += sum(1 for d in diags if d.severity >= Severity.ERROR)
+    need = ("fault_injected", "collective_timeout", "host_loss",
+            "checkpoint_save", "elastic_resume", "sdc_suspect", "sdc_rerun")
+    missing = [k for k in need if not summary["kinds"].get(k)]
+    if missing:
+        n_errors += 1
+        print(f"    FAILED: missing event kinds: {missing}")
+    if summary.get("unrecovered_faults"):
+        n_errors += 1
+        print(f"    FAILED: unrecovered faults: {summary['unrecovered_faults']}")
+    monitor.set_event_log(None)
+    print(f"\nlint_traces --chaos-multihost: {n_errors} error(s)")
+    return n_errors
+
+
+_USAGE = ("usage: lint_traces.py [pattern] | --chaos | --chaos-multihost | "
+          "--multichip | --events <log.jsonl> [...] [--storm-threshold N]")
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+
+    if "--_chaos-multihost-inner" in argv:
+        return 1 if _chaos_multihost_inner() else 0
+
+    if "--chaos-multihost" in argv:
+        return 1 if _chaos_multihost_smoke() else 0
 
     if "--chaos" in argv:
         return 1 if _chaos_smoke() else 0

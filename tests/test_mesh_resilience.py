@@ -1,0 +1,671 @@
+"""Mesh-wide fault tolerance (ISSUE 9): the distributed chaos matrix.
+
+Collective watchdog (hang → typed ``CollectiveTimeoutError`` naming trace
+lines + the suspected host from straggler data), elastic resharded resume
+(fsdp4·tp2 checkpoint restored onto fsdp2·tp2 / 8×1 / single-device
+layouts, bitwise reshard round-trips, trajectory continuation after a
+host loss), SDC guards (replica checksums, chaos bit-flip injection,
+quarantine + re-run inside ``run_training``), the chaos grammar's
+``host=`` targeting and per-process RNG streams, the process-0 checkpoint
+commit discipline, and the event-schema/correlation additions.
+
+Runs in-process on the 8-virtual-device CPU platform (tests/conftest.py).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import thunder_tpu.monitor as monitor
+from thunder_tpu.resilience import chaos, elastic, watchdog
+from thunder_tpu.resilience.preemption import (
+    CheckpointManager,
+    HostLost,
+    run_training,
+)
+from thunder_tpu.resilience.watchdog import (
+    CollectiveTimeoutError,
+    SDCDetectedError,
+    SDCGuard,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolation(monkeypatch):
+    """No ambient chaos/watchdog/metrics; watchdog + host-health reset."""
+    monkeypatch.setenv("THUNDER_TPU_RETRY_BACKOFF_S", "0")
+    monkeypatch.delenv("THUNDER_TPU_CHAOS", raising=False)
+    monkeypatch.delenv("THUNDER_TPU_COLLECTIVE_TIMEOUT_S", raising=False)
+    monkeypatch.delenv("THUNDER_TPU_CHAOS_PROCESS_INDEX", raising=False)
+    chaos.reset_env_config()
+    watchdog.configure(None)
+    watchdog.note_host_health(None)
+    was = monitor.enabled()
+    monitor.disable()
+    monitor.reset()
+    yield
+    monitor.reset()
+    (monitor.enable if was else monitor.disable)()
+    watchdog.configure(None)
+    watchdog.note_host_health(None)
+    chaos.reset_env_config()
+
+
+def _events(path):
+    return [json.loads(line) for line in open(path)]
+
+
+def _kinds(path):
+    return [r["kind"] for r in _events(path)]
+
+
+# =============================================================================
+# Chaos grammar: host targeting + per-process RNG streams
+# =============================================================================
+
+
+class TestMeshChaosGrammar:
+    def test_host_clause_parses(self):
+        cfg = chaos.parse_spec("collective_hang@host=2~0.5;host_loss@3,host=1;sdc*2")
+        hang, loss, sdc = cfg.rules
+        assert (hang.seam, hang.host, hang.delay_s) == ("collective_hang", 2, 0.5)
+        assert (loss.seam, loss.target, loss.host) == ("host_loss", "3", 1)
+        assert (sdc.seam, sdc.count, sdc.host) == ("sdc", 2, None)
+
+    def test_malformed_host_clause_raises(self):
+        with pytest.raises(ValueError, match="host clause"):
+            chaos.parse_spec("oom@host=abc")
+
+    def test_host_targeting_gates_firing(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_CHAOS_PROCESS_INDEX", "0")
+        with chaos.chaos_scope("host_loss@1,host=3"):
+            assert not chaos.host_loss_at_step(1)  # we are host 0, rule wants 3
+        monkeypatch.setenv("THUNDER_TPU_CHAOS_PROCESS_INDEX", "3")
+        with chaos.chaos_scope("host_loss@1,host=3"):
+            assert chaos.host_loss_at_step(1)
+
+    def test_per_process_rng_streams(self, monkeypatch):
+        """Same seed, different process index → different (but individually
+        replayable) %prob schedules — the satellite fix: one shared stream
+        made multi-process schedules diverge from the documented replay."""
+
+        def draws(pidx):
+            monkeypatch.setenv("THUNDER_TPU_CHAOS_PROCESS_INDEX", str(pidx))
+            cfg = chaos.parse_spec("oom*inf%0.5;seed=11")
+            return [cfg.rng.random() for _ in range(8)]
+
+        assert draws(0) == draws(0)  # replayable per process
+        assert draws(0) != draws(1)  # independent across processes
+
+    def test_step_targeted_host_loss_exact_match(self):
+        with chaos.chaos_scope("host_loss@3"):
+            assert not chaos.host_loss_at_step(13)
+            assert chaos.host_loss_at_step(3)
+            assert not chaos.host_loss_at_step(3)  # count 1: disarmed
+
+
+# =============================================================================
+# Collective watchdog
+# =============================================================================
+
+
+class TestCollectiveWatchdog:
+    def test_passthrough_when_disabled(self):
+        assert watchdog.guard_call(lambda a: a * 2, (21,), fn_name="f") == 42
+
+    def test_worker_exception_propagates(self):
+        with pytest.raises(ZeroDivisionError):
+            watchdog.guard_call(lambda: 1 / 0, (), fn_name="f", timeout_s=5.0)
+
+    def test_timeout_raises_typed_error(self, tmp_path):
+        log = str(tmp_path / "ev.jsonl")
+        monitor.set_event_log(log)
+        try:
+            with chaos.chaos_scope("collective_hang~2.0"):
+                with pytest.raises(CollectiveTimeoutError) as ei:
+                    watchdog.guard_call(
+                        lambda: 1, (), fn_name="step", timeout_s=0.1,
+                        trace_lines=["L3.synchronize", "L9.reduce_scatter"],
+                    )
+        finally:
+            monitor.set_event_log(None)
+        e = ei.value
+        assert e.timeout_s == 0.1
+        assert "L3.synchronize" in str(e)
+        kinds = _kinds(log)
+        assert "fault_injected" in kinds and "collective_timeout" in kinds
+        rec = next(r for r in _events(log) if r["kind"] == "collective_timeout")
+        assert rec["lines"] == ["L3.synchronize", "L9.reduce_scatter"]
+
+    def test_timeout_names_suspected_straggler(self):
+        """The detection→action join: host_health's straggler becomes the
+        suspect in the timeout error."""
+        records = [
+            {"kind": "step_time", "host": h, "s": (0.5 if h == 2 else 0.1),
+             "fn": "step", "step": s}
+            for h in range(4) for s in range(3)
+        ]
+        summary, _ = monitor.host_health(records)
+        assert summary["stragglers"] == [2]
+        with chaos.chaos_scope("collective_hang~2.0"):
+            with pytest.raises(CollectiveTimeoutError) as ei:
+                watchdog.guard_call(lambda: 1, (), fn_name="s", timeout_s=0.05)
+        assert ei.value.suspected_host == 2
+        assert monitor.last_host_health() is summary
+
+    def test_env_configuration(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_COLLECTIVE_TIMEOUT_S", "7.5")
+        watchdog._config["resolved"] = False
+        assert watchdog.active_timeout() == 7.5
+        monitor.configure_watchdog(None)
+        assert watchdog.active_timeout() is None
+
+    def test_wrap_probes_at_call_time(self):
+        calls = []
+        guarded = watchdog.wrap(lambda x: calls.append(x) or x, fn_name="g")
+        assert guarded(5) == 5  # disabled: plain passthrough
+        monitor.configure_watchdog(3.0)
+        assert guarded(6) == 6  # armed: runs through guard_call
+        assert calls == [5, 6]
+
+    def test_collective_trace_lines(self):
+        """dist_prims collectives of a traced program name their lines."""
+        from thunder_tpu.api import trace_program
+        from thunder_tpu.distributed import prims as dist
+        from thunder_tpu.distributed.prims import collective_trace_lines
+        import thunder_tpu.torch as ttorch
+
+        def f(w, x):
+            w2 = dist.synchronize(w, "dp", 8)
+            return ttorch.sum(ttorch.linear(x, w2))
+
+        w = np.random.randn(4, 4).astype(np.float32)
+        x = np.random.randn(2, 4).astype(np.float32)
+        _, comp = trace_program(f, (w, x), {})
+        lines = collective_trace_lines(comp)
+        assert any("synchronize" in ln for ln in lines)
+        assert all(ln.startswith("L") for ln in lines)
+
+    def test_shard_map_callable_guarded(self):
+        """A hung explicit-collective program times out with its trace
+        lines instead of blocking the host."""
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from thunder_tpu.distributed import prims as dist
+        from thunder_tpu.distributed.runtime import compile_with_collectives
+        from thunder_tpu.parallel import make_mesh
+
+        mesh = make_mesh(dp=8)
+        x = np.arange(16, dtype=np.float32).reshape(8, 2)
+
+        def f(a):
+            return dist.all_reduce(a, "dp", 8)
+
+        jf, extrace = compile_with_collectives(
+            f, (x[:1],), mesh, (P("dp", None),), P(None, None)
+        )
+        out = jf(jnp.asarray(x))  # unguarded: plain call works
+        np.testing.assert_allclose(np.asarray(out)[0], x.sum(0))
+        monitor.configure_watchdog(0.1)
+        with chaos.chaos_scope("collective_hang~2.0"):
+            with pytest.raises(CollectiveTimeoutError) as ei:
+                jf(jnp.asarray(x))
+        assert any("all_reduce" in ln for ln in ei.value.trace_lines)
+
+
+# =============================================================================
+# SDC guard
+# =============================================================================
+
+
+def _replicated_state(value=None):
+    from jax.sharding import PartitionSpec as P
+
+    from thunder_tpu.parallel import make_mesh
+    from thunder_tpu.parallel.sharding import shard_pytree
+
+    mesh = make_mesh(dp=8)
+    w = value if value is not None else np.arange(16, dtype=np.float32).reshape(4, 4)
+    return shard_pytree({"w": w}, mesh, {"w": P()}), mesh
+
+
+class TestSDCGuard:
+    def test_clean_state_has_no_divergence(self):
+        state, _ = _replicated_state()
+        cs = watchdog.replica_checksums(state)
+        assert cs  # 8 replicas of one shard
+        assert watchdog.divergent_leaves(cs) == {}
+
+    def test_chaos_corruption_detected_and_attributed(self):
+        state, _ = _replicated_state()
+        with chaos.chaos_scope("sdc*1"):
+            bad = chaos.maybe_corrupt_replica(state)
+        div = watchdog.divergent_leaves(watchdog.replica_checksums(bad))
+        assert list(div) == ["leaf0"]
+        # Default ordinal 1 → exactly one minority device
+        assert len(watchdog.suspect_devices(div)) == 1
+
+    def test_corruption_targets_replica_ordinal(self):
+        state, _ = _replicated_state()
+        with chaos.chaos_scope("sdc@2*1"):
+            bad = chaos.maybe_corrupt_replica(state)
+        div = watchdog.divergent_leaves(watchdog.replica_checksums(bad))
+        assert watchdog.suspect_devices(div) == [2]
+
+    def test_fully_sharded_leaf_skipped(self):
+        """No replicas → nothing to cross-check (and no readback paid)."""
+        from jax.sharding import PartitionSpec as P
+
+        from thunder_tpu.parallel import make_mesh
+        from thunder_tpu.parallel.sharding import shard_pytree
+
+        mesh = make_mesh(fsdp=8)
+        st = shard_pytree(
+            {"w": np.arange(64, dtype=np.float32).reshape(8, 8)}, mesh,
+            {"w": P("fsdp", None)},
+        )
+        assert watchdog.replica_checksums(st) == {}
+        with chaos.chaos_scope("sdc*1"):
+            out = chaos.maybe_corrupt_replica(st)  # nothing corruptible
+        assert out is st
+
+    def test_loss_spike_heuristic(self):
+        g = SDCGuard(loss_spike_factor=10.0)
+        for v in (1.0, 1.1, 0.9, 1.0):
+            assert not g.loss_suspect(v)
+        assert g.loss_suspect(50.0)
+        assert g.loss_suspect(float("nan"))
+        assert not g.loss_suspect(1.05)  # spike did not poison the median
+
+    def test_resolve(self):
+        assert watchdog.resolve_sdc_guard(None) is None
+        assert watchdog.resolve_sdc_guard(False) is None
+        assert isinstance(watchdog.resolve_sdc_guard(True), SDCGuard)
+        g = SDCGuard(check_every=3)
+        assert watchdog.resolve_sdc_guard(g) is g
+        with pytest.raises(TypeError):
+            watchdog.resolve_sdc_guard("yes")
+
+
+# =============================================================================
+# run_training: SDC quarantine + re-run, host loss
+# =============================================================================
+
+
+def _mesh_step(mesh, specs):
+    """A pure-jax step over mesh-sharded state (no trace pipeline — fast)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    shd = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+
+    @jax.jit
+    def _step(state):
+        grad = jax.grad(lambda s: jnp.mean((s["w"] @ s["b"]) ** 2))(state)
+        new = {k: state[k] - 0.1 * grad[k] for k in state}
+        loss = jnp.mean((state["w"] @ state["b"]) ** 2)
+        return new, loss
+
+    def step_fn(state):
+        new, loss = _step(state)
+        new = {k: jax.device_put(v, shd[k]) for k, v in new.items()}
+        return new, float(np.asarray(loss))
+
+    return step_fn
+
+
+def _train_state(mesh, specs):
+    from thunder_tpu.parallel.sharding import shard_pytree
+
+    w = (np.arange(32, dtype=np.float32).reshape(8, 4) * 0.01)
+    b = np.ones(4, np.float32)
+    return shard_pytree({"w": w, "b": b}, mesh, specs)
+
+
+class TestRunTrainingMeshFaults:
+    def _setup(self):
+        from jax.sharding import PartitionSpec as P
+
+        from thunder_tpu.parallel import make_mesh
+
+        mesh = make_mesh(fsdp=4, tp=2)
+        specs = {"w": P("fsdp", "tp"), "b": P()}
+        return mesh, specs, _mesh_step(mesh, specs), _train_state(mesh, specs)
+
+    def test_sdc_injection_quarantined_and_rerun(self, tmp_path):
+        mesh, specs, step_fn, state0 = self._setup()
+        _, baseline = run_training(
+            step_fn, state0, 5, manager=CheckpointManager(str(tmp_path / "a"))
+        )
+        log = str(tmp_path / "ev.jsonl")
+        monitor.set_event_log(log)
+        try:
+            with chaos.chaos_scope("sdc*1"):
+                _, losses = run_training(
+                    step_fn, state0, 5,
+                    manager=CheckpointManager(str(tmp_path / "b")),
+                    sdc_guard=True,
+                )
+        finally:
+            monitor.set_event_log(None)
+        assert losses == baseline  # the corrupted step re-ran clean
+        kinds = _kinds(log)
+        assert kinds.count("sdc_suspect") == 1
+        assert kinds.count("sdc_rerun") == 1
+        rerun = next(r for r in _events(log) if r["kind"] == "sdc_rerun")
+        assert rerun["ok"] is True
+        suspect = next(r for r in _events(log) if r["kind"] == "sdc_suspect")
+        assert suspect["leaves"] == ["leaf0"]
+
+    def test_persistent_corruption_raises_typed_error(self, tmp_path):
+        mesh, specs, step_fn, state0 = self._setup()
+        # inf count: the corruption re-fires on every re-run too
+        with chaos.chaos_scope("sdc*inf"):
+            with pytest.raises(SDCDetectedError) as ei:
+                run_training(
+                    step_fn, state0, 3,
+                    manager=CheckpointManager(str(tmp_path / "c")),
+                    sdc_guard=SDCGuard(max_reruns=2),
+                )
+        assert ei.value.leaves == ["leaf0"]
+
+    def test_host_loss_checkpoints_with_mesh_meta(self, tmp_path):
+        mesh, specs, step_fn, state0 = self._setup()
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        log = str(tmp_path / "ev.jsonl")
+        monitor.set_event_log(log)
+        try:
+            with chaos.chaos_scope("host_loss@2"):
+                with pytest.raises(HostLost) as ei:
+                    run_training(step_fn, state0, 5, manager=mgr, mesh=mesh)
+        finally:
+            monitor.set_event_log(None)
+        assert ei.value.step == 2
+        meta = json.load(open(os.path.join(mgr._step_dir(2), "META.json")))
+        assert meta["mesh"]["fsdp"] == 4 and meta["mesh"]["tp"] == 2
+        kinds = _kinds(log)
+        assert "host_loss" in kinds
+        # correlation: fault_injected(host_loss) paired with checkpoint_save ok
+        from thunder_tpu.analysis.events import replay_events
+
+        summary, diags = replay_events(log, storm_threshold=16)
+        assert summary["unrecovered_faults"] == []
+
+    def test_host_loss_elastic_resume_continues_trajectory(self, tmp_path):
+        from jax.sharding import PartitionSpec as P
+
+        from thunder_tpu.parallel import make_mesh
+
+        mesh, specs, step_fn, state0 = self._setup()
+        _, baseline = run_training(
+            step_fn, state0, 6, manager=CheckpointManager(str(tmp_path / "a"))
+        )
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        with chaos.chaos_scope("host_loss@3"):
+            with pytest.raises(HostLost):
+                run_training(step_fn, state0, 6, manager=mgr, mesh=mesh)
+        # "Half the devices survive": fsdp2·tp2 over the first 4 devices.
+        mesh4 = make_mesh(fsdp=2, tp=2)
+        state, start = elastic.elastic_resume(mgr, state0, mesh=mesh4, specs=specs)
+        assert start == 3
+        step4 = _mesh_step(mesh4, specs)
+        _, cont = run_training(
+            lambda s: step4(s), state, 3,
+            manager=CheckpointManager(str(tmp_path / "b")),
+        )
+        # Documented caveat: reshard is bitwise, but the continued run's
+        # reductions re-associate on the new mesh shape — float tolerance.
+        np.testing.assert_allclose(cont, baseline[3:], rtol=1e-6)
+
+    def test_watchdog_timeout_in_run_training(self, tmp_path):
+        mesh, specs, step_fn, state0 = self._setup()
+        with chaos.chaos_scope("collective_hang~2.0"):
+            with pytest.raises(CollectiveTimeoutError):
+                run_training(
+                    step_fn, state0, 3,
+                    manager=CheckpointManager(str(tmp_path / "ck")),
+                    watchdog_timeout_s=0.1,
+                )
+
+
+# =============================================================================
+# Elastic reshard round-trips (the satellite matrix)
+# =============================================================================
+
+
+class TestReshardRoundTrips:
+    def _gpt_state(self, mesh):
+        from thunder_tpu.core import dtypes
+        from thunder_tpu.models import gpt as m
+        from thunder_tpu.parallel.sharding import gpt_param_specs, shard_pytree
+        from thunder_tpu.parallel.train import adamw_init, opt_state_specs
+
+        cfg = m.name_to_config("gpt-tiny")
+        params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+        specs = gpt_param_specs(cfg, mesh)
+        state = shard_pytree(params, mesh, specs)
+        opt = adamw_init(state)
+        return cfg, (state, opt), (specs, opt_state_specs(specs))
+
+    def test_fsdp4tp2_to_fsdp2tp2_to_8x1_and_back_bitwise(self):
+        """fsdp4·tp2 → fsdp2·tp2 → 8×1 → back: per-leaf bitwise equality of
+        the gathered params and optimizer state at every hop."""
+        from thunder_tpu.core.pytree import tree_flatten
+        from thunder_tpu.models import gpt as m
+        from thunder_tpu.parallel import make_mesh
+        from thunder_tpu.parallel.sharding import gather_pytree, gpt_param_specs
+        from thunder_tpu.parallel.train import opt_state_specs
+
+        mesh842 = make_mesh(fsdp=4, tp=2)
+        cfg, state, specs842 = self._gpt_state(mesh842)
+        reference = gather_pytree(state)
+        ref_flat, _ = tree_flatten(reference)
+
+        hops = [
+            make_mesh(fsdp=2, tp=2),  # half the devices survive
+            make_mesh(fsdp=8),        # 8×1: tp collapsed
+            make_mesh(fsdp=4, tp=2),  # back to the original shape
+        ]
+        current = state
+        for mesh in hops:
+            p_specs = gpt_param_specs(cfg, mesh)
+            specs = (p_specs, opt_state_specs(p_specs))
+            current = elastic.reshard_state(current, mesh, specs)
+            got_flat, _ = tree_flatten(gather_pytree(current))
+            assert len(got_flat) == len(ref_flat)
+            for a, b in zip(got_flat, ref_flat):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_reshard_to_single_device(self):
+        from jax.sharding import PartitionSpec as P
+
+        from thunder_tpu.core.pytree import tree_flatten
+        from thunder_tpu.core.pytree import tree_map
+        from thunder_tpu.parallel import make_mesh
+        from thunder_tpu.parallel.sharding import gather_pytree
+
+        mesh842 = make_mesh(fsdp=4, tp=2)
+        cfg, state, specs = self._gpt_state(mesh842)
+        mesh1 = make_mesh(fsdp=1)  # single-host, single-device layout
+        rep_specs = tree_map(
+            lambda s: P(), specs, is_leaf=lambda x: type(x).__name__ == "PartitionSpec"
+        )
+        moved = elastic.reshard_state(state, mesh1, rep_specs)
+        a, _ = tree_flatten(gather_pytree(moved))
+        b, _ = tree_flatten(gather_pytree(state))
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_elastic_resume_checkpoint_across_mesh_shapes(self, tmp_path):
+        """Save on fsdp4·tp2, elastic-resume on fsdp2·tp2: bitwise state,
+        elastic_resume event records from/to shapes."""
+        from thunder_tpu.core.pytree import tree_flatten
+        from thunder_tpu.models import gpt as m
+        from thunder_tpu.parallel import make_mesh
+        from thunder_tpu.parallel.sharding import gather_pytree, gpt_param_specs
+        from thunder_tpu.parallel.train import opt_state_specs
+
+        mesh8 = make_mesh(fsdp=4, tp=2)
+        cfg, state, _ = self._gpt_state(mesh8)
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(state, 7, rng_seed=3, mesh=mesh8)
+
+        mesh4 = make_mesh(fsdp=2, tp=2)
+        p_specs = gpt_param_specs(cfg, mesh4)
+        log = str(tmp_path / "ev.jsonl")
+        monitor.set_event_log(log)
+        try:
+            restored, start = elastic.elastic_resume(
+                mgr, state, mesh=mesh4, specs=(p_specs, opt_state_specs(p_specs))
+            )
+        finally:
+            monitor.set_event_log(None)
+        assert start == 7
+        a, _ = tree_flatten(gather_pytree(restored))
+        b, _ = tree_flatten(gather_pytree(state))
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        rec = next(r for r in _events(log) if r["kind"] == "elastic_resume")
+        assert rec["from_mesh"]["fsdp"] == 4 and rec["to_mesh"]["fsdp"] == 2
+        assert rec["resharded"] is True
+
+    def test_fresh_start_reshards_init_state(self, tmp_path):
+        from jax.sharding import PartitionSpec as P
+
+        from thunder_tpu.parallel import make_mesh
+
+        mesh = make_mesh(fsdp=2, tp=2)
+        specs = {"w": P("fsdp", "tp"), "b": P()}
+        host_state = {"w": np.ones((8, 4), np.float32), "b": np.ones(4, np.float32)}
+        mgr = CheckpointManager(str(tmp_path / "empty"))
+        state, start = elastic.elastic_resume(mgr, host_state, mesh=mesh, specs=specs)
+        assert start == 0
+        assert state["w"].sharding.spec == specs["w"]
+
+
+# =============================================================================
+# CheckpointManager: multi-host commit discipline
+# =============================================================================
+
+
+class TestPrimaryCommitDiscipline:
+    def test_non_primary_skips_meta_and_gc(self, tmp_path, monkeypatch):
+        from thunder_tpu.resilience import preemption
+
+        mgr = CheckpointManager(str(tmp_path), keep=1)
+        monkeypatch.setattr(preemption, "_is_primary", lambda: False)
+        mgr.save({"x": np.ones(2, np.float32)}, 1)
+        # non-primary wrote the payload but no META, no rename, no GC
+        assert mgr.latest_complete_step() is None
+        assert os.path.isdir(mgr._step_dir(1) + ".tmp")
+
+    def test_primary_commits_and_gcs(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=1)
+        for s in (1, 2):
+            mgr.save({"x": np.full(2, s, np.float32)}, s, mesh={"fsdp": 4})
+        assert mgr.latest_complete_step() == 2
+        assert mgr.steps_on_disk() == [2]  # keep=1 swept step 1
+        _, meta = mgr.restore()
+        assert meta["mesh"] == {"fsdp": 4}
+
+
+# =============================================================================
+# Event schema + correlation for the new kinds
+# =============================================================================
+
+
+class TestMeshEventSchema:
+    def _replay(self, recs, **kw):
+        from thunder_tpu.analysis.events import replay_events
+
+        import tempfile
+
+        path = os.path.join(tempfile.mkdtemp(), "log.jsonl")
+        with open(path, "w") as f:
+            for i, r in enumerate(recs):
+                base = {"v": 1, "ts": float(i), "seq": i, "pid": 1, "host": 0}
+                base.update(r)
+                f.write(json.dumps(base) + "\n")
+        return replay_events(path, **kw)
+
+    def test_new_kinds_validate(self):
+        summary, diags = self._replay([
+            {"kind": "collective_timeout", "fn": "step", "timeout_s": 1.0,
+             "lines": ["L1.synchronize"], "suspected_host": 2},
+            {"kind": "host_loss", "step": 3, "host": 1},
+            {"kind": "elastic_resume", "step": 3, "from_mesh": {"fsdp": 4},
+             "to_mesh": {"fsdp": 2}, "resharded": True},
+            {"kind": "sdc_suspect", "step": 5, "leaves": ["leaf0"]},
+            {"kind": "sdc_rerun", "step": 5, "ok": True},
+        ])
+        assert not diags
+
+    def test_unrecovered_collective_hang_flagged(self):
+        summary, diags = self._replay([
+            {"kind": "fault_injected", "seam": "collective_hang",
+             "target": None, "n": 1},
+        ])
+        assert summary["unrecovered_faults"] == ["collective_hang@None"]
+        summary, diags = self._replay([
+            {"kind": "fault_injected", "seam": "collective_hang",
+             "target": None, "n": 1},
+            {"kind": "collective_timeout", "fn": "step", "timeout_s": 1.0,
+             "lines": [], "suspected_host": None},
+        ])
+        assert summary["unrecovered_faults"] == []
+
+    def test_failed_sdc_rerun_does_not_count_as_recovery(self):
+        summary, _ = self._replay([
+            {"kind": "fault_injected", "seam": "sdc", "target": "leaf0", "n": 1},
+            {"kind": "sdc_rerun", "step": 1, "ok": False},
+        ])
+        assert summary["unrecovered_faults"] == ["sdc@leaf0"]
+        summary, _ = self._replay([
+            {"kind": "fault_injected", "seam": "sdc", "target": "leaf0", "n": 1},
+            {"kind": "sdc_rerun", "step": 1, "ok": True},
+        ])
+        assert summary["unrecovered_faults"] == []
+
+    def test_host_loss_recovers_via_checkpoint(self):
+        summary, _ = self._replay([
+            {"kind": "fault_injected", "seam": "host_loss", "target": "2", "n": 1},
+            {"kind": "checkpoint_save", "path": "p", "step": 2, "ok": True,
+             "attempt": 0},
+        ])
+        assert summary["unrecovered_faults"] == []
+
+
+# =============================================================================
+# Metrics
+# =============================================================================
+
+
+class TestMeshMetrics:
+    def test_watchdog_and_sdc_metrics(self, tmp_path):
+        from thunder_tpu.observability import metrics as obsm
+
+        monitor.enable()
+        with chaos.chaos_scope("collective_hang~2.0"):
+            with pytest.raises(CollectiveTimeoutError):
+                watchdog.guard_call(lambda: 1, (), fn_name="mstep", timeout_s=0.05)
+        assert obsm.WATCHDOG_TIMEOUTS.value(fn="mstep") == 1
+
+        from jax.sharding import PartitionSpec as P
+
+        from thunder_tpu.parallel import make_mesh
+
+        mesh = make_mesh(fsdp=4, tp=2)
+        specs = {"w": P("fsdp", "tp"), "b": P()}
+        step_fn = _mesh_step(mesh, specs)
+        state0 = _train_state(mesh, specs)
+        with chaos.chaos_scope("sdc*1"):
+            run_training(
+                step_fn, state0, 3,
+                manager=CheckpointManager(str(tmp_path / "ck")), sdc_guard=True,
+            )
+        assert obsm.SDC_SUSPECTS.value() == 1
+        assert obsm.SDC_RERUNS.value(ok="true") == 1

@@ -51,6 +51,13 @@ SCHEMA: dict[str, frozenset] = {
     "checkpoint_restore": frozenset({"path", "step", "ok"}),
     "preemption": frozenset({"signal", "step"}),
     "cache_repair": frozenset({"action", "path", "reason"}),
+    # Mesh-wide fault tolerance (ISSUE 9; docs/robustness.md "distributed
+    # resilience").
+    "collective_timeout": frozenset({"fn", "timeout_s", "lines", "suspected_host"}),
+    "host_loss": frozenset({"step", "host"}),
+    "elastic_resume": frozenset({"step", "from_mesh", "to_mesh", "resharded"}),
+    "sdc_suspect": frozenset({"step", "leaves"}),
+    "sdc_rerun": frozenset({"step", "ok"}),
 }
 _COMMON = frozenset({"v", "ts", "seq", "kind"})
 
@@ -67,6 +74,14 @@ FAULT_RECOVERY_KINDS: dict[str, frozenset] = {
     "ckpt_io": frozenset({"checkpoint_save"}),
     "preempt": frozenset({"checkpoint_save"}),
     "cache_corrupt": frozenset({"cache_repair"}),
+    # Mesh-wide seams (ISSUE 9): a hung collective is "recovered" by the
+    # watchdog turning it into a typed, attributed timeout; a host loss by
+    # the survivors' agreed checkpoint (the elastic resume happens in the
+    # NEXT process, whose log carries elastic_resume); an SDC injection by
+    # the guard's quarantine + re-run.
+    "collective_hang": frozenset({"collective_timeout"}),
+    "host_loss": frozenset({"checkpoint_save"}),
+    "sdc": frozenset({"sdc_rerun"}),
 }
 
 
@@ -202,6 +217,12 @@ def host_health(
                     hint="per-host step logs merge via merge_event_logs; the "
                          "spread gauge is thunder_tpu_host_step_time_spread_ratio",
                 ))
+    # Detection → action (ISSUE 9): the collective watchdog names this
+    # summary's straggler as the suspected host when a collective later
+    # times out.
+    from thunder_tpu.resilience import watchdog as _watchdog
+
+    _watchdog.note_host_health(summary)
     return summary, diags
 
 
@@ -331,9 +352,11 @@ def replay_events(
             elif kind == "fault_injected":
                 fault_events.append((lineno, str(rec["seam"]), rec))
             elif kind in ("executor_demoted", "compile_deopt", "nan_guard",
-                          "cache_repair"):
+                          "cache_repair", "collective_timeout"):
                 recovery_positions.setdefault(kind, []).append(lineno)
-            elif kind == "checkpoint_save":
+            elif kind in ("checkpoint_save", "sdc_rerun"):
+                # Only a SUCCESSFUL save/re-run proves recovery: a failed
+                # attempt must not satisfy the correlation rule.
                 if rec.get("ok"):
                     recovery_positions.setdefault(kind, []).append(lineno)
 

@@ -56,6 +56,7 @@ from thunder_tpu.observability import events as obs_events
 from thunder_tpu.observability import metrics as obsm
 from thunder_tpu.resilience import chaos as chaos_mod
 from thunder_tpu.resilience import deopt as deopt_mod
+from thunder_tpu.resilience import watchdog as watchdog_mod
 from thunder_tpu.transforms.common import cse, dce
 from thunder_tpu.transforms.rng import RNG_TAG, functionalize_rng_ops
 
@@ -958,7 +959,27 @@ def _run_entry(entry: CacheEntry, flat_inps: tuple, prepared=None) -> Any:
                 trc is not None and int(trc.tags.get("collective_bytes") or 0)
             )
         )
-    out = entry.computation_fn(*inps)
+    if watchdog_mod.active_timeout() is not None:
+        # Collective watchdog (ISSUE 9): a dispatch whose trace contains
+        # dist_prims collectives runs under the configured timeout, so a
+        # peer that stops participating raises a typed CollectiveTimeoutError
+        # naming the pending trace lines instead of hanging this host
+        # forever. One dict probe per call when no timeout is configured.
+        if entry.collective_lines is None:
+            from thunder_tpu.distributed import prims as dist_prims
+
+            trc = entry.computation_traces[-1] if entry.computation_traces else None
+            entry.collective_lines = tuple(dist_prims.collective_trace_lines(trc))
+        if entry.collective_lines:
+            out = watchdog_mod.guard_call(
+                entry.computation_fn, tuple(inps),
+                fn_name=getattr(entry.computation_fn, "__name__", "computation"),
+                trace_lines=entry.collective_lines,
+            )
+        else:
+            out = entry.computation_fn(*inps)
+    else:
+        out = entry.computation_fn(*inps)
     if entry.sym_spec is not None:
         out = jaxex.crop_to_extents(out, entry.sym_spec, true_extents)
     if entry.on_nan is not None and not deopt_mod.outputs_finite(out):

@@ -215,6 +215,11 @@ class CacheEntry:
     # after the scope exits, so the xla_compile phase event needs the id
     # carried explicitly to correlate with the build's compile_phase events.
     compile_id: Any = None
+    # Lazily-resolved "L<idx>.<sym>" labels of the execution trace's
+    # collective dispatch sites (None = not yet computed, () = none): what
+    # the collective watchdog names in a CollectiveTimeoutError and the
+    # gate deciding whether a dispatch is guarded at all (api._run_entry).
+    collective_lines: Any = None
     stats: EntryStats = field(default_factory=EntryStats)
 
 

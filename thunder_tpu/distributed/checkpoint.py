@@ -40,18 +40,23 @@ class AsyncSaveHandle:
     """Returned by ``save(..., async_save=True)``: the write happens on a
     background thread (reference analogue: the async fsspec writer the
     torch.distributed.checkpoint stack offers); call ``wait()`` before
-    relying on the files."""
+    relying on the files. ``ckptr=None`` marks an already-durable save
+    (the pickle fallback writes synchronously) — ``wait()`` is a no-op."""
 
     def __init__(self, ckptr):
         self._ckptr = ckptr
 
     def wait(self) -> None:
-        self._ckptr.wait_until_finished()
+        if self._ckptr is not None:
+            self._ckptr.wait_until_finished()
 
 
-def _gather_full(state: Any) -> Any:
-    """Gather every (possibly sharded) array to a host numpy array."""
+def gather_full(state: Any) -> Any:
+    """Gather every (possibly sharded) array to a host numpy array —
+    the full_state_dict export and the host leg of a reshard
+    (``parallel.sharding.reshard_pytree``)."""
     import jax
+    import numpy as np
 
     from thunder_tpu.core.pytree import tree_map
 
@@ -62,9 +67,12 @@ def _gather_full(state: Any) -> Any:
             from jax.experimental import multihost_utils
 
             return multihost_utils.process_allgather(x, tiled=True)
-        return jax.device_get(x)
+        return np.asarray(jax.device_get(x))
 
     return tree_map(gather, state)
+
+
+_gather_full = gather_full  # pre-ISSUE-9 private spelling
 
 
 def save(
@@ -86,13 +94,23 @@ def save(
     """
     options = options or StateDictOptions()
     if options.full_state_dict:
-        state = _gather_full(state)
+        state = gather_full(state)
         # rank0_only: every process must still enter ckptr.save — Orbax runs
         # global sync barriers inside save(), so returning early on nonzero
-        # ranks deadlocks process 0 (ADVICE r4). After _gather_full the
+        # ranks deadlocks process 0 (ADVICE r4). After gather_full the
         # leaves are replicated host arrays, which Orbax writes from the
         # primary host only — that IS the rank0-consolidated export.
-    ckptr = _checkpointer(async_save=async_save)
+    try:
+        ckptr = _checkpointer(async_save=async_save)
+    except ImportError:
+        # No Orbax in this environment (CPU dev, tests): a host-local pickle
+        # of the gathered state keeps the single-process story working.
+        # Every consumer gets the same fallback instead of reimplementing it
+        # (CheckpointManager used to carry its own copy).
+        _pickle_save(gather_full(state), path)
+        # The pickle write is synchronous, but async_save callers were
+        # promised a handle — hand back an already-finished one.
+        return AsyncSaveHandle(None) if async_save else None
     ckptr.save(os.path.abspath(path), state)
     if async_save:
         return AsyncSaveHandle(ckptr)
@@ -101,11 +119,38 @@ def save(
     return None
 
 
+_PICKLE_NAME = "state.pkl"
+
+
+def _pickle_save(host_state: Any, path: str) -> None:
+    import pickle
+
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, _PICKLE_NAME), "wb") as f:
+        pickle.dump(host_state, f)
+
+
+def _pickle_load(path: str) -> Any:
+    import pickle
+
+    with open(os.path.join(os.path.abspath(path), _PICKLE_NAME), "rb") as f:
+        return pickle.load(f)
+
+
 def load(path: str, *, template: Any = None, mesh=None, specs=None) -> Any:
     """Restore a pytree; with ``mesh``+``specs`` the arrays are restored
     directly into the target sharding — which may be a different mesh SHAPE
     than the save used (reference: `load:197` resharding via DTensor; here
-    TensorStore reads + shard_pytree re-lays-out)."""
+    TensorStore reads + shard_pytree re-lays-out). The pickle fallback (no
+    Orbax) reshards the host arrays by device_put instead."""
+    if os.path.isfile(os.path.join(os.path.abspath(path), _PICKLE_NAME)):
+        state = _pickle_load(path)
+        if mesh is not None and specs is not None:
+            from thunder_tpu.parallel.sharding import shard_pytree
+
+            return shard_pytree(state, mesh, specs)
+        return state
     ckptr = _checkpointer()
     if mesh is not None and specs is not None:
         # Restore DIRECTLY into the target sharding: TensorStore reads only

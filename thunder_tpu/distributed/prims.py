@@ -153,6 +153,38 @@ mask_to_rank = _make(DistOpIDs.MASK_TO_RANK, "mask_to_rank", _mask_to_rank_meta)
 register_module("dist_prims", __import__("sys").modules[__name__])
 
 
+def is_collective_bsym(bsym) -> bool:
+    """True for a BoundSymbol that dispatches a collective — its sym id is a
+    :class:`DistOpIDs` or it carries the COMM_OP tag (generic passes and
+    the watchdog treat both uniformly)."""
+    from thunder_tpu.core.prims import OpTags
+
+    sym = getattr(bsym, "sym", None)
+    if sym is None:
+        return False
+    if isinstance(sym.id, DistOpIDs):
+        return True
+    return OpTags.COMM_OP in (getattr(sym, "tags", None) or ())
+
+
+def collective_trace_lines(trace, limit: int = 8) -> list:
+    """``L<idx>.<sym>`` labels of a trace's collective dispatch sites — the
+    same spelling the annotated codegen stamps into HLO scopes, so a
+    :class:`~thunder_tpu.resilience.watchdog.CollectiveTimeoutError` names
+    lines an operator can join against profiles and the cost model's
+    per-line wire bounds. ``limit`` caps the list (a deep FSDP trace has
+    hundreds of synchronize sites; the first few identify the program)."""
+    if trace is None:
+        return []
+    lines = []
+    for i, bsym in enumerate(getattr(trace, "bound_symbols", ()) or ()):
+        if is_collective_bsym(bsym):
+            lines.append(f"L{i}.{bsym.sym.name}")
+            if limit and len(lines) >= limit:
+                break
+    return lines
+
+
 # -- jax executor implementations ---------------------------------------------
 # Valid inside shard_map over a mesh with the named axis.
 

@@ -16,8 +16,17 @@ from __future__ import annotations
 from typing import Any, Callable, Optional, Sequence
 
 
-def shard_map_callable(fn: Callable, mesh, in_specs, out_specs, *, check_rep: bool = False) -> Callable:
-    """Wrap a pure callable in shard_map over ``mesh`` and jit it."""
+def shard_map_callable(fn: Callable, mesh, in_specs, out_specs, *, check_rep: bool = False,
+                       trace_lines=None) -> Callable:
+    """Wrap a pure callable in shard_map over ``mesh`` and jit it.
+
+    The result routes through the collective watchdog
+    (``resilience/watchdog.guard_call``) whenever a timeout is configured
+    (``THUNDER_TPU_COLLECTIVE_TIMEOUT_S`` / ``watchdog.configure``): a
+    shard_map program IS a collective dispatch site, so a peer that stops
+    participating raises a typed ``CollectiveTimeoutError`` (naming
+    ``trace_lines`` when the caller has them) instead of hanging the host
+    forever. Unconfigured, the wrapper is one dict probe per call."""
     import jax
 
     try:
@@ -25,8 +34,14 @@ def shard_map_callable(fn: Callable, mesh, in_specs, out_specs, *, check_rep: bo
     except ImportError:  # newer jax
         from jax.shard_map import shard_map  # type: ignore
 
+    from thunder_tpu.resilience import watchdog
+
     inner = shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_rep)
-    return jax.jit(inner)
+    return watchdog.wrap(
+        jax.jit(inner),
+        fn_name=getattr(fn, "__name__", "shard_map"),
+        trace_lines=trace_lines,
+    )
 
 
 def compile_with_collectives(
@@ -49,10 +64,16 @@ def compile_with_collectives(
     from thunder_tpu.transforms.autodiff import grad_transform
     from thunder_tpu.transforms.common import dce
 
+    from thunder_tpu.distributed.prims import collective_trace_lines
+
     _, comp = trace_program(fn, example_args, {})
     comp = dce(comp)
     if grad:
         comp = grad_transform(comp, return_value=True)
     extrace = transform_for_execution(comp, resolve_executors(None))
     inner = extrace.python_callable()
-    return shard_map_callable(inner, mesh, in_specs, out_specs), extrace
+    jf = shard_map_callable(
+        inner, mesh, in_specs, out_specs,
+        trace_lines=collective_trace_lines(extrace),
+    )
+    return jf, extrace

@@ -73,6 +73,28 @@ def host_health(source, *, spread_threshold: float = 1.5):
     return _hh(source, spread_threshold=spread_threshold)
 
 
+def configure_watchdog(timeout_s) -> None:
+    """Arm (None disarms) the collective watchdog process-wide — the
+    programmatic spelling of ``THUNDER_TPU_COLLECTIVE_TIMEOUT_S``. A
+    dispatch containing collectives that exceeds the timeout raises a typed
+    ``CollectiveTimeoutError`` naming the pending collective trace lines
+    and the suspected host (from the last :func:`host_health` summary)
+    instead of hanging forever (docs/robustness.md "distributed
+    resilience")."""
+    from thunder_tpu.resilience import watchdog
+
+    watchdog.configure(timeout_s)
+
+
+def last_host_health():
+    """The most recent :func:`host_health` summary this process computed —
+    the straggler record the collective watchdog joins its timeout errors
+    against. None until ``host_health`` has run."""
+    from thunder_tpu.resilience import watchdog
+
+    return watchdog.last_host_health()
+
+
 def dump_json(path: str) -> None:
     """Write the full snapshot (with a timestamp) as JSON to ``path``."""
     REGISTRY.dump_json(path)

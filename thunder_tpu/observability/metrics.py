@@ -410,6 +410,24 @@ CHECKPOINT_RETRIES = REGISTRY.counter(
     "thunder_tpu_checkpoint_retries_total",
     "Checkpoint save attempts retried after transient I/O errors",
 )
+# Mesh-wide fault tolerance (ISSUE 9; docs/robustness.md "distributed
+# resilience"): the collective watchdog, elastic resume, and SDC guard.
+WATCHDOG_TIMEOUTS = REGISTRY.counter(
+    "thunder_tpu_collective_watchdog_timeouts_total",
+    "Guarded dispatches abandoned after the collective timeout, labelled by fn",
+)
+ELASTIC_RESUMES = REGISTRY.counter(
+    "thunder_tpu_elastic_resumes_total",
+    "Checkpoint restores resharded onto a different mesh shape",
+)
+SDC_SUSPECTS = REGISTRY.counter(
+    "thunder_tpu_sdc_suspects_total",
+    "Replica-checksum divergences (or loss spikes) flagged by the SDC guard",
+)
+SDC_RERUNS = REGISTRY.counter(
+    "thunder_tpu_sdc_reruns_total",
+    "Quarantined-step re-runs by the SDC guard, labelled ok=true|false",
+)
 # inc_always: a dropped observability sink must be visible even with the
 # metrics gate off — silent loss of the event log is the failure mode this
 # counter exists to expose (monitor.report() lists it unconditionally).

@@ -42,6 +42,21 @@ class MeshConfig:
         return {"dp": self.dp, "pp": self.pp, "fsdp": self.fsdp,
                 "ep": self.ep, "sp": self.sp, "tp": self.tp}
 
+    @classmethod
+    def from_mesh(cls, mesh) -> "MeshConfig":
+        """Recover the config from a live ``jax.sharding.Mesh`` (axes the
+        mesh doesn't carry default to 1)."""
+        return cls(**{a: int(n) for a, n in axis_sizes(mesh).items()
+                      if a in AXIS_ORDER})
+
+
+def axis_sizes(mesh) -> dict[str, int]:
+    """``{axis: size}`` of a live mesh — the mesh-shape record the elastic
+    checkpoint metadata stores (``CheckpointManager.save(mesh=...)``) and
+    the resume path compares against the surviving mesh
+    (``resilience/elastic.py``)."""
+    return {str(a): int(n) for a, n in zip(mesh.axis_names, mesh.devices.shape)}
+
 
 def make_mesh(config: MeshConfig | dict | None = None, *, devices: Optional[Sequence] = None, **axes):
     """Build a `jax.sharding.Mesh` with the given axis sizes.

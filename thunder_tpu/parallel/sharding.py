@@ -155,3 +155,25 @@ def shard_pytree(tree, mesh, specs):
 
     out = [jax.device_put(x, NamedSharding(mesh, s)) for x, s in zip(flat, flat_specs)]
     return tree_unflatten(spec_struct, out)
+
+
+def gather_pytree(tree):
+    """Every (possibly sharded) jax leaf gathered to a host numpy array —
+    the mesh-independent intermediate of a reshard. Multi-process arrays go
+    through ``process_allgather`` (distributed/checkpoint.gather_full)."""
+    from thunder_tpu.distributed.checkpoint import gather_full
+
+    return gather_full(tree)
+
+
+def reshard_pytree(tree, mesh, specs):
+    """Re-lay-out a pytree onto a (possibly different-shape) mesh per
+    ``specs``: gather to host, then :func:`shard_pytree` onto the target.
+
+    This is the small-state elastic-resume path (``resilience/elastic.py``)
+    — values are bit-identical after the round trip (only the layout
+    changes); at checkpoint scale the Orbax restore
+    (``distributed/checkpoint.load(mesh=..., specs=...)``) reads only the
+    byte ranges each surviving device needs instead of materializing full
+    arrays."""
+    return shard_pytree(gather_pytree(tree), mesh, specs)

@@ -34,6 +34,19 @@ def adamw_init(params):
     return {"step": jnp.zeros((), dtype=jnp.int32), "m": zeros, "v": tree_map(lambda p: jnp.zeros_like(p), params)}
 
 
+def opt_state_specs(param_specs, optimizer: str = "adamw"):
+    """PartitionSpec pytree for the optimizer state matching
+    :func:`adamw_init`'s structure: moments inherit the param specs (ZeRO
+    sharding for free), the step counter replicates. The elastic-resume
+    path (``resilience/elastic.py``) reshards saved optimizer state through
+    exactly these specs, so they live here next to the init."""
+    from jax.sharding import PartitionSpec
+
+    if optimizer == "sgd":
+        return {"step": PartitionSpec()}
+    return {"step": PartitionSpec(), "m": param_specs, "v": param_specs}
+
+
 def adamw_update(params, grads, state, *, lr=1e-3, b1=0.9, b2=0.95, eps=1e-8, weight_decay=0.0):
     import jax.numpy as jnp
 
@@ -158,7 +171,7 @@ def build_train_step(
                         is_leaf=lambda x: isinstance(x, PartitionSpec))
 
     param_sh = ns(ps)
-    opt_sh = {"step": NamedSharding(mesh, PartitionSpec()), "m": param_sh, "v": param_sh}
+    opt_sh = ns(opt_state_specs(ps, optimizer))
     data_sh = NamedSharding(mesh, batch_spec)
     loss_sh = NamedSharding(mesh, PartitionSpec())
 

@@ -19,7 +19,14 @@ the runtime actually walk that ladder under fault:
   detection on restore, and the ``resume()`` path;
 - :mod:`~thunder_tpu.resilience.compile_cache` — persistent XLA
   compilation-cache integrity sweep (corrupted/truncated entries are
-  deleted and recompiled instead of crashing).
+  deleted and recompiled instead of crashing);
+- :mod:`~thunder_tpu.resilience.watchdog` — the collective watchdog
+  (typed ``CollectiveTimeoutError`` instead of hanging forever on a dead
+  peer, joined against host-health straggler data) and the SDC guard
+  (cross-replica checksums, quarantine + re-run) — ISSUE 9;
+- :mod:`~thunder_tpu.resilience.elastic` — elastic resharded resume:
+  restore a checkpoint written by one mesh shape onto a different
+  (smaller) mesh after a host loss — ISSUE 9.
 
 See docs/robustness.md for the fault model and the chaos spec grammar.
 """
@@ -42,13 +49,21 @@ from thunder_tpu.resilience.demotion import (  # noqa: F401
     quarantine_snapshot,
 )
 from thunder_tpu.resilience.deopt import NonFiniteOutputError  # noqa: F401
+from thunder_tpu.resilience.elastic import elastic_resume, reshard_state  # noqa: F401
 from thunder_tpu.resilience.preemption import (  # noqa: F401
     CheckpointManager,
     CheckpointRestoreError,
     CheckpointWriteError,
+    HostLost,
+    Preempted,
     PreemptionGuard,
     resume,
     run_training,
+)
+from thunder_tpu.resilience.watchdog import (  # noqa: F401
+    CollectiveTimeoutError,
+    SDCDetectedError,
+    SDCGuard,
 )
 
 __all__ = [
@@ -59,4 +74,7 @@ __all__ = [
     "NonFiniteOutputError",
     "PreemptionGuard", "CheckpointManager", "CheckpointWriteError",
     "CheckpointRestoreError", "resume", "run_training",
+    "Preempted", "HostLost",
+    "CollectiveTimeoutError", "SDCDetectedError", "SDCGuard",
+    "elastic_resume", "reshard_state",
 ]

@@ -27,6 +27,17 @@ Seams and their typed errors:
                    step-boundary checkpoint + resume)
 ``cache_corrupt``  truncates a persistent compile-cache entry (recovery:
                    :mod:`~.compile_cache` sweep)
+``collective_hang`` a peer stops participating in a collective — sleeps
+                   ``~<delay>`` seconds inside the watchdog-guarded
+                   dispatch (recovery: :mod:`~.watchdog` raises a typed
+                   :class:`~.watchdog.CollectiveTimeoutError`)
+``host_loss``      a host dies at a chosen training step (recovery:
+                   step-boundary checkpoint agreement + elastic resume on
+                   the surviving mesh, :mod:`~.elastic`)
+``sdc``            silent data corruption — flips one mantissa bit in one
+                   data-parallel replica's shard of the training state
+                   (recovery: the SDC replica-checksum guard quarantines
+                   and re-runs the step, :class:`~.watchdog.SDCGuard`)
 =================  =====================================================
 
 Spec grammar (``THUNDER_TPU_CHAOS=<spec>`` or ``jit(chaos=<spec>)``)::
@@ -34,22 +45,34 @@ Spec grammar (``THUNDER_TPU_CHAOS=<spec>`` or ``jit(chaos=<spec>)``)::
     spec      := component (";" component)*
     component := "seed=" INT
                | seam ["@" target] ["*" count] ["%" prob] ["~" delay_s]
+    target    := clause ("," clause)*
+    clause    := "host=" INT | <seam-specific target>
     count     := INT | "inf"          (default 1: fire once, then disarm)
     prob      := FLOAT in (0, 1]      (default 1.0; drawn from the seeded RNG)
     delay_s   := FLOAT                (straggler sleep seconds, default 0.01)
 
 ``target`` is seam-specific: for ``kernel_raise`` an executor name or
 ``executor:op`` substring; for ``nan`` a BoundSymbol-name substring or
-``L<index>``; for ``preempt`` the step number. Examples::
+``L<index>``; for ``preempt``/``host_loss`` the step number; for ``sdc``
+the replica ordinal to corrupt. A ``host=N`` clause restricts any seam to
+the process with ``jax.process_index() == N`` (multi-host targeting; the
+``THUNDER_TPU_CHAOS_PROCESS_INDEX`` env var overrides the index for
+single-process simulation and tests). Examples::
 
     THUNDER_TPU_CHAOS="kernel_raise@flash*1"
     THUNDER_TPU_CHAOS="oom*2;seed=7"
     THUNDER_TPU_CHAOS="nan@tanh;preempt@3"
+    THUNDER_TPU_CHAOS="collective_hang@host=2~30;seed=5"
+    THUNDER_TPU_CHAOS="host_loss@3,host=1"
 
 Every injection emits a ``fault_injected`` JSONL event and increments
 ``thunder_tpu_faults_injected_total{seam=...}``. Injection decisions are
 deterministic given the spec (counts + seeded RNG): the same spec replays
-the same fault schedule.
+the same fault schedule. The probability RNG is seeded with
+``seed + process_index()`` so every host of a multi-process job draws an
+independent — but individually replayable — stream (all hosts sharing one
+stream would make multi-process ``%prob`` schedules diverge from the
+single-host replay of the same spec).
 """
 
 from __future__ import annotations
@@ -68,7 +91,31 @@ from thunder_tpu.observability import metrics as obsm
 SEAMS = (
     "kernel_raise", "compile_fail", "compile_timeout", "oom", "nan",
     "straggler", "ckpt_io", "preempt", "cache_corrupt",
+    "collective_hang", "host_loss", "sdc",
 )
+
+
+def process_index() -> int:
+    """This process's mesh-wide index: ``THUNDER_TPU_CHAOS_PROCESS_INDEX``
+    when set (single-process multi-host simulation, tests), else
+    ``jax.process_index()`` from an already-initialized backend, else 0.
+    Chaos must never be the thing that initializes the jax backend."""
+    env = os.environ.get("THUNDER_TPU_CHAOS_PROCESS_INDEX", "").strip()
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    import sys
+
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            if jax_mod._src.xla_bridge._backends:  # type: ignore[attr-defined]
+                return int(jax_mod.process_index())
+        except Exception:
+            pass
+    return 0
 
 
 class ChaosError(RuntimeError):
@@ -148,6 +195,7 @@ class FaultRule:
     count: float = 1  # float so "inf" parses; compared against fired
     prob: float = 1.0
     delay_s: float = 0.01
+    host: Optional[int] = None  # host=N clause: only this process fires
     fired: int = 0
 
     def exhausted(self) -> bool:
@@ -160,16 +208,30 @@ class FaultRule:
             return False
         return self.target in str(target)
 
+    def host_matches(self) -> bool:
+        return self.host is None or self.host == process_index()
+
 
 @dataclass
 class ChaosConfig:
-    """Parsed chaos spec: rules + the seeded RNG driving probability draws."""
+    """Parsed chaos spec: rules + the seeded RNG driving probability draws.
+
+    The RNG is created lazily on first draw and seeded with
+    ``seed + process_index()``: each host of a multi-process job gets its
+    own replayable stream (laziness matters — specs parse before the jax
+    backend knows the process index)."""
 
     rules: list = field(default_factory=list)
     seed: int = 0
 
     def __post_init__(self):
-        self.rng = random.Random(self.seed)
+        self._rng: Optional[random.Random] = None
+
+    @property
+    def rng(self) -> random.Random:
+        if self._rng is None:
+            self._rng = random.Random(self.seed + process_index())
+        return self._rng
 
     def rules_for(self, seam: str):
         return [r for r in self.rules if r.seam == seam]
@@ -206,7 +268,22 @@ def parse_spec(spec: str) -> ChaosConfig:
                 setattr(rule, _attr[sep], float(val))
         if "@" in rest:
             rest, _, target = rest.partition("@")
-            rule.target = target.strip() or None
+            # A target is a comma-list of clauses; "host=N" clauses restrict
+            # the rule to that process, the remainder is the seam target.
+            plain = []
+            for clause in target.split(","):
+                clause = clause.strip()
+                if clause.startswith("host="):
+                    try:
+                        rule.host = int(clause[len("host="):])
+                    except ValueError:
+                        raise ValueError(
+                            f"chaos spec: malformed host clause {clause!r} "
+                            f"in component {comp!r}"
+                        ) from None
+                elif clause:
+                    plain.append(clause)
+            rule.target = ",".join(plain) or None
         rule.seam = rest.strip()
         if rule.seam not in SEAMS:
             raise ValueError(
@@ -283,7 +360,7 @@ def _should_fire(seam: str, target: Optional[str] = None) -> Optional[FaultRule]
     if cfg is None:
         return None
     for rule in cfg.rules_for(seam):
-        if rule.exhausted() or not rule.matches(target):
+        if rule.exhausted() or not rule.matches(target) or not rule.host_matches():
             continue
         if rule.prob < 1.0 and cfg.rng.random() >= rule.prob:
             continue
@@ -338,7 +415,7 @@ def run_seam(has_collectives: bool = False) -> None:
         raise InjectedOOMError()
     cfg = active()
     for rule in cfg.rules_for("straggler"):
-        if rule.exhausted():
+        if rule.exhausted() or not rule.host_matches():
             continue
         if rule.target != "any" and not has_collectives:
             continue
@@ -361,11 +438,24 @@ def preempt_at_step(step: int) -> bool:
     """Training-loop seam: True when an armed ``preempt`` rule targets this
     step (exact match — ``preempt@3`` must not also fire at step 13) or has
     no target. The caller treats it exactly like a SIGTERM."""
+    return _step_seam_fires("preempt", step)
+
+
+def host_loss_at_step(step: int) -> bool:
+    """Training-loop seam: True when an armed ``host_loss`` rule targets
+    this step (or has no step target). The caller checkpoints at the step
+    boundary and raises :class:`~.preemption.HostLost` — the surviving
+    processes' elastic-resume path (``resilience/elastic.py``) continues on
+    a shrunk mesh from that agreed checkpoint."""
+    return _step_seam_fires("host_loss", step)
+
+
+def _step_seam_fires(seam: str, step: int) -> bool:
     cfg = active()
     if cfg is None:
         return False
-    for rule in cfg.rules_for("preempt"):
-        if rule.exhausted():
+    for rule in cfg.rules_for(seam):
+        if rule.exhausted() or not rule.host_matches():
             continue
         if rule.target is not None and rule.target != str(step):
             continue
@@ -375,6 +465,19 @@ def preempt_at_step(step: int) -> bool:
         _record(rule, str(step))
         return True
     return False
+
+
+def collective_hang_seam() -> None:
+    """Collective-dispatch seam, called INSIDE the watchdog-guarded call
+    (``resilience/watchdog.guard_call``): an armed ``collective_hang`` rule
+    sleeps ``~<delay>`` seconds — a peer that stopped participating, from
+    this process's point of view — so a delay longer than the watchdog
+    timeout exercises the typed-timeout path end to end."""
+    if active() is None:
+        return
+    rule = _should_fire("collective_hang")
+    if rule is not None:
+        time.sleep(rule.delay_s)
 
 
 def corrupt_cache_seam(cache_dir: str) -> Optional[str]:
@@ -397,6 +500,78 @@ def corrupt_cache_seam(cache_dir: str) -> Optional[str]:
     with open(victim, "w"):
         pass  # truncate
     return victim
+
+
+# -- silent-data-corruption seam -----------------------------------------------
+
+
+def maybe_corrupt_replica(state):
+    """When an armed ``sdc`` rule fires, flip one mantissa bit in ONE
+    data-parallel replica's shard of the first replicated leaf of ``state``
+    (a pytree of jax Arrays) and rebuild the array from its per-device
+    buffers — the replicas now disagree bitwise while the "official" value
+    XLA would read is unchanged, which is exactly what a silent hardware
+    corruption looks like. Returns the (possibly corrupted) state.
+
+    The rule's target selects the replica ordinal to corrupt (default 1 —
+    a non-primary copy, so at least one honest peer disagrees). Leaves with
+    no replication (every device holds a distinct shard) cannot host a
+    replica divergence and are skipped."""
+    cfg = active()
+    if cfg is None or not any(
+        not r.exhausted() and r.host_matches() for r in cfg.rules_for("sdc")
+    ):
+        return state
+
+    import jax
+    import numpy as np
+
+    from thunder_tpu.core.pytree import tree_flatten, tree_unflatten
+
+    flat, spec = tree_flatten(state)
+    for i, leaf in enumerate(flat):
+        if not isinstance(leaf, jax.Array) or not leaf.shape or leaf.size == 0:
+            continue
+        if not np.issubdtype(np.dtype(leaf.dtype), np.floating):
+            continue
+        try:
+            shards = list(leaf.addressable_shards)
+        except Exception:
+            continue
+        groups: dict = {}
+        for sh in shards:
+            groups.setdefault(str(sh.index), []).append(sh)
+        replicas = next((g for g in groups.values() if len(g) > 1), None)
+        if replicas is None:
+            continue
+        # The sdc target is the replica ordinal, not a match filter, so rule
+        # selection bypasses the generic substring matching.
+        rule = None
+        for r in cfg.rules_for("sdc"):
+            if r.exhausted() or not r.host_matches():
+                continue
+            if r.prob < 1.0 and cfg.rng.random() >= r.prob:
+                continue
+            rule = r
+            break
+        if rule is None:
+            return state
+        rule.fired += 1
+        _record(rule, f"leaf{i}")
+        ordinal = int(rule.target) if rule.target and rule.target.isdigit() else 1
+        victim = replicas[min(ordinal, len(replicas) - 1)]
+        data = np.array(victim.data)  # host copy of the victim shard
+        data.view(np.uint8).reshape(-1)[0] ^= 1  # mantissa LSB of element 0
+        bufs = [
+            jax.device_put(data if sh is victim else np.asarray(sh.data), sh.device)
+            for sh in shards
+        ]
+        flat = list(flat)
+        flat[i] = jax.make_array_from_single_device_arrays(
+            leaf.shape, leaf.sharding, bufs
+        )
+        return tree_unflatten(spec, flat)
+    return state
 
 
 # -- NaN poisoning pass --------------------------------------------------------

@@ -1,0 +1,100 @@
+"""Elastic resharded resume: continue a training run on a DIFFERENT mesh.
+
+Losing a host on a preemptible TPU fleet shrinks the device set; the run
+must continue on what survives instead of waiting for a replacement
+(Gemma-on-Cloud-TPU operational comparison, PAPERS.md). The ingredients:
+
+- :class:`~thunder_tpu.resilience.preemption.CheckpointManager` records the
+  **mesh shape** (``parallel.mesh.axis_sizes``) in each step's META commit
+  marker (``save(mesh=...)``);
+- :func:`elastic_resume` restores the newest complete checkpoint and, when
+  the target mesh's shape differs from the saved one, **reshards** the
+  params/optimizer pytree through its PartitionSpec pytree
+  (``parallel.sharding.reshard_pytree`` host path here; the Orbax restore
+  path in ``distributed/checkpoint.load(mesh=..., specs=...)`` reads only
+  the byte ranges each surviving device needs at scale);
+- the caller rebuilds its step function for the surviving mesh
+  (``parallel.build_train_step``) and continues from the restored step.
+
+Numerics caveat (documented, asserted in tests): resharding is bitwise —
+gather + device_put never touches values — but the *continued run* on a
+different mesh shape reduces grads/loss in a different order (XLA reduction
+trees follow the partitioning), so the post-resume loss trajectory matches
+the uninterrupted one to float tolerance, not bitwise. Resuming onto the
+SAME mesh shape stays bitwise (that path is PR 6's
+``tests/test_resilience.py::TestPreemption``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from thunder_tpu.observability import events as obs_events
+from thunder_tpu.observability import metrics as obsm
+from thunder_tpu.resilience.preemption import CheckpointManager
+
+
+def mesh_shape(mesh) -> Optional[dict]:
+    """``{axis: size}`` of a mesh, or None — the shape record checkpoints
+    carry and the resume path compares."""
+    if mesh is None:
+        return None
+    from thunder_tpu.parallel.mesh import axis_sizes
+
+    return axis_sizes(mesh)
+
+
+def reshard_state(state: Any, mesh, specs) -> Any:
+    """Re-lay-out a state pytree onto ``mesh`` per its PartitionSpec pytree
+    (bitwise: only the layout changes)."""
+    from thunder_tpu.parallel.sharding import reshard_pytree
+
+    return reshard_pytree(state, mesh, specs)
+
+
+def elastic_resume(
+    manager: CheckpointManager,
+    init_state: Any,
+    *,
+    mesh=None,
+    specs=None,
+) -> tuple[Any, int]:
+    """(state, start_step) like
+    :func:`~thunder_tpu.resilience.preemption.resume`, but landing the
+    restored state on ``mesh`` (per ``specs``, a PartitionSpec pytree
+    matching the state structure) even when the checkpoint was written by a
+    different mesh shape — the surviving-devices path after a host loss.
+
+    Emits an ``elastic_resume`` event recording the saved → target shape
+    and bumps ``thunder_tpu_elastic_resumes_total`` when an actual reshard
+    happened. With no checkpoint on disk, returns ``(init_state, 0)``
+    (``init_state`` is resharded too when it isn't already laid out on
+    ``mesh`` — a fresh elastic start is just a reshard from nothing)."""
+    if manager.latest_complete_step() is None:
+        if mesh is not None and specs is not None:
+            init_state = reshard_state(init_state, mesh, specs)
+        return init_state, 0
+
+    state, meta = manager.restore()
+    saved_shape = meta.get("mesh")
+    target_shape = mesh_shape(mesh)
+    resharded = False
+    if mesh is not None and specs is not None:
+        # Restored leaves are host arrays (pickle fallback) or arrays on the
+        # saving mesh (Orbax) — either way, land them on the target layout.
+        state = reshard_state(state, mesh, specs)
+        resharded = saved_shape is not None and saved_shape != target_shape
+    obs_events.emit_event(
+        "elastic_resume",
+        step=int(meta["step"]),
+        from_mesh=saved_shape,
+        to_mesh=target_shape,
+        resharded=resharded,
+    )
+    if resharded and obsm.enabled():
+        obsm.ELASTIC_RESUMES.inc()
+    if meta.get("rng_seed") is not None:
+        from thunder_tpu import api
+
+        api._global_rng["seed"] = int(meta["rng_seed"])
+    return state, int(meta["step"])

@@ -53,6 +53,83 @@ class Preempted(RuntimeError):
         super().__init__(f"preempted: checkpoint written at step {step} ({path})")
 
 
+class HostLost(RuntimeError):
+    """Raised by :func:`run_training` when the chaos ``host_loss`` seam (or
+    a caller-signalled peer death) fires at a step boundary, after the
+    surviving processes agreed on and durably wrote a checkpoint. The
+    caller rebuilds a mesh from the surviving devices and continues via
+    :func:`~thunder_tpu.resilience.elastic.elastic_resume` — unlike
+    :class:`Preempted`, the next process is expected to run on a SMALLER
+    mesh."""
+
+    def __init__(self, step: int, path: str):
+        self.step = step
+        self.path = path
+        super().__init__(
+            f"host lost: checkpoint written at step {step} ({path}); "
+            f"resume on the surviving mesh via resilience.elastic"
+        )
+
+
+def _is_primary() -> bool:
+    """True for the process that owns META commit markers and retention
+    sweeps (jax process 0; single-process = always). Keeping marker writes
+    on one host closes the multi-host double-write/partial-retention race:
+    two hosts renaming the same step dir or GC-ing different step sets
+    corrupt the directory's commit protocol."""
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return jax.process_index() == 0
+    except Exception:
+        pass
+    return True
+
+
+def _multihost_all(local_ok: bool) -> bool:
+    """True iff EVERY process reports ``local_ok`` (single-process: the
+    local flag). Doubles as the commit sync point: non-primary hosts wait
+    here for the primary's META/rename to land before trusting the
+    directory state — and learn whether it actually landed, so a failed
+    save cannot masquerade as durable on the hosts whose own writes
+    succeeded."""
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            import jax.numpy as jnp
+            from jax.experimental import multihost_utils
+
+            agreed = multihost_utils.process_allgather(
+                jnp.asarray(1 if local_ok else 0, jnp.int32)
+            )
+            return bool(agreed.min())
+    except Exception:
+        pass
+    return local_ok
+
+
+def _multihost_any(local: bool) -> bool:
+    """True iff ANY process reports ``local`` (single-process: the local
+    flag) — the agreement primitive for 'one host saw it, every host must
+    act on it' decisions (preemption flags, host-loss signals)."""
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            import jax.numpy as jnp
+            from jax.experimental import multihost_utils
+
+            agreed = multihost_utils.process_allgather(
+                jnp.asarray(1 if local else 0, jnp.int32)
+            )
+            return bool(agreed.max())
+    except Exception:
+        pass
+    return local
+
+
 class PreemptionGuard:
     """SIGTERM-triggered stop flag with multihost agreement.
 
@@ -111,22 +188,7 @@ class PreemptionGuard:
     def should_checkpoint(self, step: Optional[int] = None) -> bool:
         """Multihost-synced stop decision: any host's flag stops every
         host, so all hosts enter the same collective checkpoint save."""
-        local = self.requested_local(step)
-        try:
-            import jax
-
-            if jax.process_count() > 1:
-                import jax.numpy as jnp
-                from jax.experimental import multihost_utils
-
-                agreed = multihost_utils.process_allgather(
-                    jnp.asarray(1 if local else 0, jnp.int32)
-                )
-                return bool(agreed.max())
-        except Exception:
-            # No initialized distributed backend: the local flag is the truth.
-            pass
-        return local
+        return _multihost_any(self.requested_local(step))
 
 
 class CheckpointManager:
@@ -178,76 +240,105 @@ class CheckpointManager:
 
     # -- save -----------------------------------------------------------------
 
-    def save(self, state: Any, step: int, *, rng_seed: Optional[int] = None) -> str:
+    def save(self, state: Any, step: int, *, rng_seed: Optional[int] = None,
+             mesh=None) -> str:
         """Write ``state`` for ``step`` with retry/backoff on transient I/O
-        errors. Returns the committed directory path."""
+        errors. Returns the committed directory path.
+
+        ``mesh`` (a ``jax.sharding.Mesh`` or an ``{axis: size}`` dict)
+        records the mesh SHAPE that wrote the checkpoint in the META commit
+        marker — the record :func:`~thunder_tpu.resilience.elastic.
+        elastic_resume` compares against the surviving mesh to decide
+        whether a reshard is needed.
+
+        Multi-host discipline: every process writes the (collective) state
+        payload, but ONLY process 0 writes the META marker, renames the
+        step into place, and runs retention sweeps; the other hosts barrier
+        on the commit — two hosts racing the rename/GC is the
+        double-write/partial-retention hazard this closes."""
         final = self._step_dir(step)
+        primary = _is_primary()
+        mesh_meta = None
+        if mesh is not None:
+            if isinstance(mesh, dict):
+                mesh_meta = {str(k): int(v) for k, v in mesh.items()}
+            else:
+                from thunder_tpu.parallel.mesh import axis_sizes
+
+                mesh_meta = axis_sizes(mesh)
         attempt = 0
+        terminal: Optional[OSError] = None
         while True:
             tmp = final + ".tmp"
             try:
                 chaos.checkpoint_seam()
-                if os.path.isdir(tmp):
+                if primary and os.path.isdir(tmp):
                     shutil.rmtree(tmp)
                 self._write_state(state, tmp)
-                meta = {
-                    "step": int(step),
-                    "rng_seed": int(rng_seed) if rng_seed is not None else None,
-                    "ts": time.time(),
-                }
-                with open(os.path.join(tmp, self.META), "w") as f:
-                    json.dump(meta, f)
-                if os.path.isdir(final):
-                    shutil.rmtree(final)
-                os.rename(tmp, final)
+                if primary:
+                    meta = {
+                        "step": int(step),
+                        "rng_seed": int(rng_seed) if rng_seed is not None else None,
+                        "mesh": mesh_meta,
+                        "ts": time.time(),
+                    }
+                    with open(os.path.join(tmp, self.META), "w") as f:
+                        json.dump(meta, f)
+                    if os.path.isdir(final):
+                        shutil.rmtree(final)
+                    os.rename(tmp, final)
+                break
             except OSError as e:
                 obs_events.emit_event(
                     "checkpoint_save", path=final, step=int(step), ok=False,
                     attempt=attempt, error=str(e),
                 )
                 if attempt >= self.retries:
-                    raise CheckpointWriteError(
-                        f"checkpoint save for step {step} failed after "
-                        f"{attempt + 1} attempt(s) at seam ckpt_io: {e}"
-                    ) from e
+                    # Terminal — but this host must still reach the commit
+                    # sync below: raising here would strand every peer in
+                    # the agreement collective (a failed save must not
+                    # become a mesh-wide hang).
+                    terminal = e
+                    break
                 if obsm.enabled():
                     obsm.CHECKPOINT_RETRIES.inc()
                 if self.backoff_s:
                     time.sleep(min(self.backoff_s * (2 ** attempt), 2.0))
                 attempt += 1
-                continue
-            obs_events.emit_event(
-                "checkpoint_save", path=final, step=int(step), ok=True,
-                attempt=attempt,
+        # Commit sync: every host reports its terminal status and learns the
+        # fleet's. Non-primary hosts both wait for the primary's META/rename
+        # to land AND find out whether it did — a step is durable only when
+        # EVERY writer committed.
+        all_ok = _multihost_all(terminal is None)
+        if terminal is not None:
+            raise CheckpointWriteError(
+                f"checkpoint save for step {step} failed after "
+                f"{attempt + 1} attempt(s) at seam ckpt_io: {terminal}"
+            ) from terminal
+        if not all_ok:
+            raise CheckpointWriteError(
+                f"checkpoint save for step {step} failed on a peer host — "
+                f"the step was not committed"
             )
+        obs_events.emit_event(
+            "checkpoint_save", path=final, step=int(step), ok=True,
+            attempt=attempt,
+        )
+        if primary:
             self._gc()
-            return final
+        return final
 
     def _write_state(self, state: Any, tmp_dir: str) -> None:
+        # distributed/checkpoint.save: Orbax sharded save, or the host-local
+        # pickle fallback when Orbax is absent (tests, CPU dev).
         from thunder_tpu.distributed import checkpoint as dckpt
 
-        payload_dir = os.path.join(tmp_dir, "state")
-        try:
-            dckpt.save(state, payload_dir)
-        except ImportError:
-            # No Orbax in this environment: a host-local pickle keeps the
-            # single-process story (tests, CPU dev) working.
-            import pickle
-
-            os.makedirs(tmp_dir, exist_ok=True)
-            import jax
-
-            host_state = jax.tree_util.tree_map(
-                lambda x: __import__("numpy").asarray(x)
-                if isinstance(x, jax.Array) else x,
-                state,
-            )
-            with open(os.path.join(tmp_dir, "state.pkl"), "wb") as f:
-                pickle.dump(host_state, f)
+        os.makedirs(tmp_dir, exist_ok=True)
+        dckpt.save(state, os.path.join(tmp_dir, "state"))
 
     def _read_state(self, step_dir: str) -> Any:
         pkl = os.path.join(step_dir, "state.pkl")
-        if os.path.isfile(pkl):
+        if os.path.isfile(pkl):  # pre-ISSUE-9 layout: pickle at the top level
             import pickle
 
             with open(pkl, "rb") as f:
@@ -334,6 +425,9 @@ def run_training(
     guard: Optional[PreemptionGuard] = None,
     save_every: int = 0,
     on_loss: Optional[Callable] = None,
+    mesh=None,
+    sdc_guard=None,
+    watchdog_timeout_s: Optional[float] = None,
 ) -> tuple[Any, list]:
     """Drive ``step_fn(state) -> (state, loss)`` for ``n_steps`` with
     preemption-safe checkpointing.
@@ -342,34 +436,125 @@ def run_training(
     preemption guard at every step boundary (multihost-synced) and, when
     preemption is requested, saves and raises :class:`Preempted`;
     ``save_every > 0`` also checkpoints on that cadence. Returns
-    ``(final_state, losses_this_run)``."""
-    from thunder_tpu import api
+    ``(final_state, losses_this_run)``.
 
+    Mesh-wide resilience (ISSUE 9):
+
+    - ``mesh`` stamps the mesh shape into every checkpoint's META marker so
+      a later :func:`~thunder_tpu.resilience.elastic.elastic_resume` can
+      reshard onto a different mesh;
+    - the chaos ``host_loss`` seam at a step boundary checkpoints and
+      raises :class:`HostLost` (the surviving processes' resume path);
+    - ``sdc_guard`` (True or a :class:`~thunder_tpu.resilience.watchdog.
+      SDCGuard`) cross-checks replica checksums after each guarded step,
+      quarantines a divergent step, and re-runs it from the previous state
+      — requires a NON-donating ``step_fn`` (the previous state must
+      survive the step);
+    - ``watchdog_timeout_s`` (or ``THUNDER_TPU_COLLECTIVE_TIMEOUT_S``)
+      runs each step under the collective watchdog, turning a hung
+      collective into a typed
+      :class:`~thunder_tpu.resilience.watchdog.CollectiveTimeoutError`."""
+    from thunder_tpu import api
+    from thunder_tpu.resilience import watchdog as wd
+
+    sdc = wd.resolve_sdc_guard(sdc_guard)
+    step_name = getattr(step_fn, "__name__", "step")
     own_guard = guard is None
     guard = guard if guard is not None else PreemptionGuard().install()
     losses: list = []
+
+    def run_step(s):
+        if watchdog_timeout_s is not None or wd.enabled():
+            return wd.guard_call(
+                step_fn, (s,), fn_name=step_name, timeout_s=watchdog_timeout_s
+            )
+        return step_fn(s)
+
     try:
         state, start = resume(manager, state)
         for step in range(start, n_steps):
             if guard.should_checkpoint(step):
                 path = manager.save(
-                    state, step, rng_seed=api._global_rng["seed"]
+                    state, step, rng_seed=api._global_rng["seed"], mesh=mesh
                 )
                 raise Preempted(step, path)
+            # Host-loss agreement runs through the same any-host collective
+            # as preemption: a host-targeted injection (host_loss@N,host=1)
+            # fires locally on one process, and every OTHER process must
+            # learn of it here and enter the same collective save — a local-
+            # only check would strand the peers in the next step's
+            # collectives while one host checkpoints alone.
+            if _multihost_any(chaos.host_loss_at_step(step)):
+                obs_events.emit_event(
+                    "host_loss", step=step, host=chaos.process_index()
+                )
+                path = manager.save(
+                    state, step, rng_seed=api._global_rng["seed"], mesh=mesh
+                )
+                raise HostLost(step, path)
             t0 = time.perf_counter()
-            state, loss = step_fn(state)
+            prev = state if sdc is not None else None
+            state, loss = run_step(state)
+            if chaos.enabled():
+                state = chaos.maybe_corrupt_replica(state)
+            if sdc is not None and sdc.due(step):
+                state, loss = _sdc_check_and_rerun(
+                    sdc, run_step, prev, state, loss, step
+                )
             losses.append(loss)
             # One step_time event per training step per host: the per-host
             # logs of a multi-host job merge into the cross-host health
             # summary (analysis/events.host_health — straggler detection).
-            obs_events.emit_event("step_time", fn=getattr(step_fn, "__name__", "step"),
+            obs_events.emit_event("step_time", fn=step_name,
                                    step=step, s=round(time.perf_counter() - t0, 6))
             if on_loss is not None:
                 on_loss(step, loss)
             done = step + 1
             if save_every and done % save_every == 0 and done < n_steps:
-                manager.save(state, done, rng_seed=api._global_rng["seed"])
+                manager.save(
+                    state, done, rng_seed=api._global_rng["seed"], mesh=mesh
+                )
         return state, losses
     finally:
         if own_guard:
             guard.uninstall()
+
+
+def _sdc_check_and_rerun(sdc, run_step, prev_state, state, loss, step):
+    """The SDC quarantine loop: on replica-checksum divergence (or a loss
+    spike when armed), discard the poisoned state, re-run the step from
+    ``prev_state``, and re-check — up to ``sdc.max_reruns`` times; a
+    divergence that survives every re-run raises
+    :class:`~thunder_tpu.resilience.watchdog.SDCDetectedError`."""
+    from thunder_tpu.resilience.watchdog import SDCDetectedError
+
+    divergence = sdc.check_state(state)
+    suspect = bool(divergence) or sdc.loss_suspect(loss)
+    if not suspect:
+        return state, loss
+    from thunder_tpu.resilience import watchdog as wd
+
+    leaves = sorted(divergence) if divergence else ["<loss-spike>"]
+    if obsm.enabled():
+        obsm.SDC_SUSPECTS.inc()
+    obs_events.emit_event(
+        "sdc_suspect", step=int(step), leaves=leaves,
+        devices=wd.suspect_devices(divergence), detail=divergence or None,
+    )
+    for attempt in range(sdc.max_reruns):
+        state, loss = run_step(prev_state)
+        if chaos.enabled():
+            # A truly bad device corrupts the re-run too: the chaos seam
+            # stays in the path so persistent (count>1) SDC rules exercise
+            # the rerun-exhausted → SDCDetectedError ladder.
+            state = chaos.maybe_corrupt_replica(state)
+        divergence = sdc.check_state(state)
+        ok = not divergence
+        if obsm.enabled():
+            obsm.SDC_RERUNS.inc(ok=str(ok).lower())
+        obs_events.emit_event(
+            "sdc_rerun", step=int(step), ok=ok, attempt=attempt
+        )
+        if ok:
+            return state, loss
+    raise SDCDetectedError(step, sorted(divergence))

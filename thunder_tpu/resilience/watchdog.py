@@ -1,0 +1,385 @@
+"""Mesh-level step guards: the collective watchdog and the SDC guard.
+
+Two detectors for faults a single process cannot see from its own stack
+traces (ISSUE 9): a peer that stopped participating in a collective (the
+job hangs forever at an all-gather with no error), and silent data
+corruption (a flipped bit in one replica's memory poisons the run with no
+signal at all). Both close the loop from PR 8's *detection* (per-host
+telemetry, straggler suspects) to *action*.
+
+**Collective watchdog** — :func:`guard_call` runs a dispatch that contains
+collectives on a worker thread and joins with a configurable timeout
+(``THUNDER_TPU_COLLECTIVE_TIMEOUT_S`` / :func:`configure`). A hung
+collective cannot be cancelled (on real hardware the ICI transfer is in
+flight; the process restarts), so on timeout the watchdog abandons the
+worker and raises a typed :class:`CollectiveTimeoutError` naming the
+collective trace lines of the guarded program and the suspected host —
+joined against the last :func:`~thunder_tpu.analysis.events.host_health`
+summary (:func:`note_host_health`), so the straggler the observatory
+flagged is the first name in the error. Dispatch sites that opt in:
+``api._run_entry`` (traces with collectives), ``distributed/runtime``'s
+shard_map callables, and ``resilience.preemption.run_training`` steps on a
+mesh. The watchdog is off unless a timeout is configured — steady-state
+overhead is one dict probe per call.
+
+**SDC guard** — :class:`SDCGuard`, armed via
+``run_training(sdc_guard=...)``: after each guarded step it cross-checks a
+cheap rolling checksum (crc32 of each addressable shard's bytes) across
+data-parallel replicas of the training state. Replicas hold bitwise-equal
+copies by construction, so any divergence is a corrupted device; the guard
+emits ``sdc_suspect`` naming the leaf and devices, quarantines the step
+(discards the poisoned state), and re-runs it from the previous state —
+``sdc_rerun`` records the outcome; a divergence that survives the re-run
+raises :class:`SDCDetectedError`. Requires a non-donating step function
+(the previous state must stay alive for the re-run).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+from thunder_tpu.observability import events as obs_events
+from thunder_tpu.observability import metrics as obsm
+from thunder_tpu.resilience import chaos
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """A guarded dispatch containing collectives did not complete within the
+    watchdog timeout — a peer stopped participating (host hang/loss) or the
+    interconnect stalled. Carries the collective trace lines of the guarded
+    program and the suspected host from the last host-health summary."""
+
+    seam = "collective_hang"
+
+    def __init__(self, fn_name: str, timeout_s: float,
+                 trace_lines: Optional[Sequence[str]] = None,
+                 suspected_host: Optional[Any] = None):
+        self.fn_name = fn_name
+        self.timeout_s = timeout_s
+        self.trace_lines = list(trace_lines or [])
+        self.suspected_host = suspected_host
+        lines = ", ".join(self.trace_lines) if self.trace_lines else \
+            "collectives inserted by the SPMD partitioner (no trace lines)"
+        suspect = (
+            f"suspected host {suspected_host} (straggler per host_health)"
+            if suspected_host is not None
+            else "no straggler data (run monitor.host_health over per-host logs)"
+        )
+        super().__init__(
+            f"collective watchdog: {fn_name!r} exceeded {timeout_s:g}s — "
+            f"a peer stopped participating; pending collectives: {lines}; "
+            f"{suspect}"
+        )
+
+
+class SDCDetectedError(RuntimeError):
+    """Replica checksums diverged and the quarantine re-run did not clear
+    it — persistent corruption (bad device memory), not a transient flip."""
+
+    seam = "sdc"
+
+    def __init__(self, step: int, leaves: Sequence[str]):
+        self.step = step
+        self.leaves = list(leaves)
+        super().__init__(
+            f"SDC guard: replica checksum divergence at step {step} survived "
+            f"the quarantine re-run (leaves: {', '.join(self.leaves)}) — "
+            f"suspect persistent device corruption"
+        )
+
+
+# -- watchdog configuration ----------------------------------------------------
+
+_config: dict = {"timeout_s": None, "resolved": False}
+_last_health: dict = {"summary": None}
+
+
+def configure(timeout_s: Optional[float]) -> None:
+    """Arm (or disarm with ``None``) the collective watchdog process-wide —
+    the programmatic spelling of ``THUNDER_TPU_COLLECTIVE_TIMEOUT_S``."""
+    _config["timeout_s"] = float(timeout_s) if timeout_s else None
+    _config["resolved"] = True
+
+
+def active_timeout() -> Optional[float]:
+    if not _config["resolved"]:
+        env = os.environ.get("THUNDER_TPU_COLLECTIVE_TIMEOUT_S", "").strip()
+        _config["timeout_s"] = float(env) if env else None
+        _config["resolved"] = True
+    return _config["timeout_s"]
+
+
+def enabled() -> bool:
+    return active_timeout() is not None
+
+
+def note_host_health(summary: Optional[dict]) -> None:
+    """Record the latest cross-host health summary
+    (``analysis/events.host_health`` calls this) so a later timeout can name
+    the suspected straggler instead of just "somewhere in the mesh"."""
+    _last_health["summary"] = summary
+
+
+def last_host_health() -> Optional[dict]:
+    return _last_health["summary"]
+
+
+def _suspected_host() -> Optional[Any]:
+    summary = _last_health["summary"]
+    if summary and summary.get("stragglers"):
+        return summary["stragglers"][0]
+    return None
+
+
+# -- the guarded call ----------------------------------------------------------
+
+
+def guard_call(
+    fn: Callable,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    *,
+    fn_name: str = "?",
+    trace_lines: Optional[Sequence[str]] = None,
+    timeout_s: Optional[float] = None,
+):
+    """Run ``fn(*args, **kwargs)`` under the collective watchdog.
+
+    With no timeout configured this is a direct call. Otherwise the call
+    runs on a daemon worker thread and the caller joins with the timeout:
+    on expiry the worker is abandoned (a hung collective cannot be
+    cancelled — production recovery is checkpoint + elastic resume in a
+    fresh process) and :class:`CollectiveTimeoutError` raises, after
+    emitting a ``collective_timeout`` event and bumping
+    ``thunder_tpu_collective_watchdog_timeouts_total``. The chaos
+    ``collective_hang`` seam fires inside the guarded region, so injected
+    hangs exercise exactly this path."""
+    timeout = timeout_s if timeout_s is not None else active_timeout()
+    if timeout is None:
+        return fn(*args, **(kwargs or {}))
+
+    import contextvars
+
+    # The worker must see the caller's context: chaos scopes and per-function
+    # event-log routing are contextvars, and a fresh thread starts from an
+    # empty context.
+    ctx = contextvars.copy_context()
+    box: dict = {}
+
+    def worker():
+        try:
+            def body():
+                chaos.collective_hang_seam()
+                return fn(*args, **(kwargs or {}))
+
+            box["out"] = ctx.run(body)
+        except BaseException as e:  # propagated to the caller below
+            box["exc"] = e
+
+    t = threading.Thread(
+        target=worker, name=f"thunder-tpu-watchdog:{fn_name}", daemon=True
+    )
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        lines = list(trace_lines or [])
+        suspect = _suspected_host()
+        if obsm.enabled():
+            obsm.WATCHDOG_TIMEOUTS.inc(fn=fn_name)
+        obs_events.emit_event(
+            "collective_timeout", fn=fn_name, timeout_s=timeout,
+            lines=lines, suspected_host=suspect,
+        )
+        raise CollectiveTimeoutError(fn_name, timeout, lines, suspect)
+    if "exc" in box:
+        raise box["exc"]
+    return box.get("out")
+
+
+class _GuardedCallable:
+    """The :func:`wrap` result: calls route through :func:`guard_call` when
+    the watchdog is armed at call time (plain passthrough otherwise), and
+    every other attribute access delegates to the wrapped callable — so a
+    wrapped ``jax.jit`` object keeps its ``lower``/``as_text``/... API and
+    consumers probing ``hasattr(jfn, "lower")`` don't silently degrade."""
+
+    def __init__(self, fn: Callable, name: str,
+                 trace_lines: Optional[Sequence[str]]):
+        self.__wrapped__ = fn
+        self._name = name
+        self._trace_lines = trace_lines
+        self.__name__ = f"watchdog[{name}]"
+
+    def __call__(self, *args, **kwargs):
+        if active_timeout() is None:
+            return self.__wrapped__(*args, **kwargs)
+        return guard_call(self.__wrapped__, args, kwargs, fn_name=self._name,
+                          trace_lines=self._trace_lines)
+
+    def __getattr__(self, item):
+        return getattr(self.__wrapped__, item)
+
+    def __repr__(self):
+        return f"<watchdog-guarded {self.__wrapped__!r}>"
+
+
+def wrap(fn: Callable, *, fn_name: Optional[str] = None,
+         trace_lines: Optional[Sequence[str]] = None) -> Callable:
+    """A callable that routes through :func:`guard_call` when the watchdog
+    is armed at call time and is a plain passthrough otherwise — dispatch
+    sites wrap once at build time and pay one probe per call. Non-call
+    attribute access (``lower``, ``as_text``, ...) passes through to
+    ``fn``."""
+    return _GuardedCallable(fn, fn_name or getattr(fn, "__name__", "?"),
+                            trace_lines)
+
+
+# =============================================================================
+# SDC guard: cross-replica checksums
+# =============================================================================
+
+
+def replica_checksums(state) -> dict:
+    """Per-leaf, per-replica-group crc32 checksums of a pytree of (possibly
+    sharded) jax Arrays.
+
+    Shards with the same global index tuple on different devices are
+    replicas of the same data and must agree bitwise; the checksum is crc32
+    over each addressable shard's bytes (host-side, C-speed — the "cheap
+    rolling checksum" of ISSUE 9). Returns
+    ``{leaf_name: {group_index: {device_ordinal: crc}}}`` covering only
+    leaves that actually have replicas."""
+    import jax
+
+    from thunder_tpu.core.pytree import tree_flatten
+
+    import numpy as np
+
+    flat, _ = tree_flatten(state)
+    out: dict = {}
+    for i, leaf in enumerate(flat):
+        if not isinstance(leaf, jax.Array) or leaf.size == 0:
+            continue
+        try:
+            shards = list(leaf.addressable_shards)
+        except Exception:
+            continue
+        if len(shards) < 2:
+            continue
+        # Group by global index FIRST and checksum only groups with >1
+        # device: a fully-sharded leaf (every device holds a distinct
+        # shard) has no replicas to cross-check, and skipping it skips the
+        # device→host readback entirely — on an fsdp×tp mesh that is most
+        # of the parameter bytes.
+        groups: dict = {}
+        for sh in shards:
+            groups.setdefault(str(sh.index), []).append(sh)
+        replicated = {}
+        for idx, members in groups.items():
+            if len(members) < 2:
+                continue
+            per_dev = {}
+            for sh in members:
+                arr = np.asarray(sh.data)
+                if not arr.flags.c_contiguous:
+                    arr = np.ascontiguousarray(arr)
+                # crc32 reads the array's buffer directly — no tobytes copy.
+                per_dev[sh.device.id] = zlib.crc32(arr)
+            replicated[idx] = per_dev
+        if replicated:
+            out[f"leaf{i}"] = replicated
+    return out
+
+
+def divergent_leaves(checksums: dict) -> dict:
+    """``{leaf: {group_index: {device: crc}}}`` restricted to groups whose
+    replicas disagree — empty means the state is replica-consistent."""
+    bad: dict = {}
+    for leaf, groups in checksums.items():
+        for idx, per_dev in groups.items():
+            if len(set(per_dev.values())) > 1:
+                bad.setdefault(leaf, {})[idx] = dict(per_dev)
+    return bad
+
+
+def suspect_devices(divergence: dict) -> list:
+    """Minority devices per divergent group — the corrupted replicas (ties
+    report every device in the group)."""
+    suspects: list = []
+    for groups in divergence.values():
+        for per_dev in groups.values():
+            counts: dict = {}
+            for crc in per_dev.values():
+                counts[crc] = counts.get(crc, 0) + 1
+            majority = max(counts.values())
+            if majority == min(counts.values()):
+                suspects.extend(per_dev)  # even split: all suspect
+            else:
+                suspects.extend(
+                    d for d, crc in per_dev.items() if counts[crc] < majority
+                )
+    return sorted(set(suspects))
+
+
+@dataclass
+class SDCGuard:
+    """Opt-in per-step silent-data-corruption guard for
+    :func:`~thunder_tpu.resilience.preemption.run_training`.
+
+    ``check_every`` thins the checksum to every Nth step (the check costs a
+    host readback of every replicated shard); ``max_reruns`` bounds the
+    quarantine re-runs per divergent step; ``loss_spike_factor`` arms the
+    gradient-norm heuristic — a finite loss larger than ``factor`` × the
+    rolling median of the last ``history`` losses is treated as an SDC
+    suspect too (catches corruption in non-replicated shards the checksum
+    cannot cross-check)."""
+
+    check_every: int = 1
+    max_reruns: int = 1
+    loss_spike_factor: Optional[float] = None
+    history: int = 8
+    _losses: list = field(default_factory=list, repr=False)
+
+    def due(self, step: int) -> bool:
+        return self.check_every > 0 and step % self.check_every == 0
+
+    def check_state(self, state) -> dict:
+        """Divergence report for ``state`` (empty dict = consistent)."""
+        return divergent_leaves(replica_checksums(state))
+
+    def loss_suspect(self, loss) -> bool:
+        """Rolling-median spike heuristic over scalar losses (see class
+        docstring); also trips on non-finite losses. Feeds the same
+        quarantine + re-run path as a checksum divergence."""
+        if self.loss_spike_factor is None:
+            return False
+        import math
+
+        try:
+            v = float(loss)
+        except (TypeError, ValueError):
+            return False
+        if not math.isfinite(v):
+            return True
+        prior = sorted(abs(x) for x in self._losses[-self.history:])
+        median = prior[len(prior) // 2] if len(prior) >= 3 else 0.0
+        spike = median > 0 and abs(v) > self.loss_spike_factor * median
+        if not spike:
+            self._losses.append(v)  # a suspect loss must not skew the median
+        return spike
+
+
+def resolve_sdc_guard(value) -> Optional[SDCGuard]:
+    """Normalize a ``run_training(sdc_guard=...)`` value: None/False off,
+    True → default :class:`SDCGuard`, or a configured instance."""
+    if not value:
+        return None
+    if value is True:
+        return SDCGuard()
+    if isinstance(value, SDCGuard):
+        return value
+    raise TypeError(f"sdc_guard must be bool or SDCGuard, got {type(value).__name__}")
