@@ -103,9 +103,28 @@ def build_train(cfg_name: str, batch: int, seq: int):
     executors = _executors()
     fw, bw = save_sdpa_residuals(fw, bw, executors)
     fw, bw = rematerialize_forward_and_backward(fw, bw)
-    fw_fn = transform_for_execution(fw, executors).python_callable()
-    bw_fn = transform_for_execution(bw, executors).python_callable()
+    fw_ex = transform_for_execution(fw, executors)
+    bw_ex = transform_for_execution(bw, executors)
+    fw_fn = fw_ex.python_callable()
+    bw_fn = bw_ex.python_callable()
     trace_s = time.perf_counter() - t0
+
+    # Static planner overhead (ISSUE 10): liveness plan + collective-schedule
+    # certificate over the claimed fw/bw traces, timed so the planner shows
+    # up in the committed compile-phase record like any other compile phase.
+    t0 = time.perf_counter()
+    try:
+        from thunder_tpu.analysis import liveness as live_mod
+        from thunder_tpu.analysis import schedule as sched_mod
+
+        peak = 0
+        for trc in (fw_ex, bw_ex):
+            peak = max(peak, live_mod.plan_liveness(trc, include_rows=False).peak_bytes)
+            sched_mod.stamp(trc)
+        predicted_peak_bytes = int(peak)
+    except Exception:
+        predicted_peak_bytes = None
+    static_analysis_s = time.perf_counter() - t0
 
     flat_params, _ = tree_flatten((params,))
 
@@ -123,7 +142,8 @@ def build_train(cfg_name: str, batch: int, seq: int):
     t0 = time.perf_counter()
     jfn, flat_params = _stage_step(step, flat_params, idx, tgt)
     stage_s = time.perf_counter() - t0
-    return jfn, flat_params, idx, tgt, init_s, trace_s, stage_s
+    return (jfn, flat_params, idx, tgt, init_s, trace_s, stage_s,
+            static_analysis_s, predicted_peak_bytes)
 
 
 def _stage_step(step, flat_params, idx, tgt):
@@ -291,7 +311,8 @@ def _bench_train():
     from thunder_tpu.api import _jax_cache_counts
 
     jax_c0 = _jax_cache_counts()
-    jfn, flat_params, idx, tgt, init_s, trace_s, stage_s = build_train("open_llama_3b", TRAIN_B, TRAIN_T)
+    (jfn, flat_params, idx, tgt, init_s, trace_s, stage_s,
+     static_s, predicted_peak) = build_train("open_llama_3b", TRAIN_B, TRAIN_T)
 
     t0 = time.perf_counter()
     flat_params, loss = jfn(flat_params, idx, tgt)
@@ -300,6 +321,12 @@ def _bench_train():
     jax_c1 = _jax_cache_counts()
     phases = {
         "trace_claim_s": round(trace_s, 2),
+        # The static planner suite (ISSUE 10): liveness + schedule
+        # certification seconds over the claimed fw/bw traces, and the
+        # plan's predicted per-device peak — visible (and gated via the
+        # committed record) like any other compile phase.
+        "static_analysis_s": round(static_s, 3),
+        "predicted_peak_bytes": predicted_peak,
         "staging_s": round(stage_s, 2),
         "xla_backend_compile_s": round(jax_c1["backend_compile_s"] - jax_c0["backend_compile_s"], 2),
         "persistent_cache_get_s": round(jax_c1["cache_get_s"] - jax_c0["cache_get_s"], 2),

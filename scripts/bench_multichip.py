@@ -103,6 +103,29 @@ def bench_fsdp_tp(args, result: dict) -> None:
         executors=_executors(), donate=False, return_extrace=True,
     )
     trace_s = time.perf_counter() - t0
+
+    # Static planner overhead on the multichip trace (ISSUE 10): liveness
+    # plan + schedule certificate, timed so the new static_analysis compile
+    # phase is visible in the committed multichip record like any other
+    # phase. The recorded peak divides INPUT params by their PartitionSpecs;
+    # intermediates have no trace-level sharding (the SPMD partitioner
+    # decides), so the number is an upper bound on per-device HBM —
+    # activations charged at global shape.
+    t0 = time.perf_counter()
+    try:
+        from thunder_tpu.analysis import liveness as live_mod
+        from thunder_tpu.analysis import schedule as sched_mod
+
+        divisors = live_mod.arg_divisors_from_specs(extrace, specs, mesh=mesh)
+        plan = live_mod.plan_liveness(
+            extrace, arg_divisors=divisors, include_rows=False
+        )
+        sched_mod.stamp(extrace)
+        predicted_peak = int(plan.peak_bytes)
+    except Exception:
+        predicted_peak = None
+    static_s = time.perf_counter() - t0
+
     t0 = time.perf_counter()
     p, o, loss = step(params, opt, idx, tgt)
     loss.block_until_ready()
@@ -215,6 +238,8 @@ def bench_fsdp_tp(args, result: dict) -> None:
         "multichip_xla_compile_s": round(compile_s, 2),
         "compile_phases": {
             "trace_claim_s": round(trace_s, 2),
+            "static_analysis_s": round(static_s, 3),
+            "predicted_peak_bytes": predicted_peak,
             "xla_backend_compile_s": round(
                 jax_c1["backend_compile_s"] - jax_c0["backend_compile_s"], 2),
             "persistent_cache_get_s": round(

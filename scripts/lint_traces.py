@@ -24,6 +24,14 @@ Usage:
         # jit(events=...)): validates the JSONL schema and flags recompile
         # storms; several per-host logs are merged with stable ordering
         # (thunder_tpu.analysis.events; docs/observability.md)
+    python scripts/lint_traces.py --static
+        # static planner smoke (ISSUE 10; docs/trace_invariants.md): GPT
+        # fwd and fwd+bwd predicted peak HBM within 15% of the
+        # instrument="memory" measured high-water; fsdp4·tp2 collective
+        # schedule certifies and uncertified reorders + donation/alias
+        # hazards are flagged; the de-opt ladder under the chaos oom@<3
+        # memory ceiling reaches its fitting level with strictly fewer
+        # failed XLA compiles than blind climbing
     python scripts/lint_traces.py --chaos
         # resilience smoke (docs/robustness.md): run the GPT gradient
         # pipeline under a canned fault schedule (kernel raise, compile
@@ -224,6 +232,266 @@ def _multichip_smoke() -> int:
 
     n_errors += _bench_history_gate("MULTICHIP_BENCH_r*.json")
     print(f"\nlint_traces --multichip: {n_errors} error(s)")
+    return n_errors
+
+
+def _static_smoke() -> int:
+    """--static: the static trace planner smoke (ISSUE 10). Three parts:
+
+    1. **Liveness/OOM prediction**: the GPT-tiny forward and fwd+bwd
+       pipelines compile with ``instrument="memory"``; the entry's
+       statically predicted peak must sit within 15% of the measured
+       high-water (``bytes_in_use`` where the backend reports it; on the
+       CPU plugin, the planner's eager-allocation total vs the hook's
+       cumulative estimate — same quantity, same tolerance).
+    2. **Collective-schedule safety**: an fsdp4·tp2-shaped gradient trace
+       certifies (both mesh axes present, grad's reduce_scatter included);
+       an uncertified same-axis reorder MUST be flagged, a certified legal
+       one MUST pass; seeded-bad donation/alias traces must each trip their
+       sanitizer rule.
+    3. **Planner-guided de-opt**: under the chaos ``oom@<3`` seam (a
+       deterministic memory ceiling that keeps OOMing below ladder level 3)
+       with ``THUNDER_TPU_HBM_BYTES`` between the padded and exact-shape
+       predicted peaks, the ladder must jump L0→L3 in ONE recompile —
+       strictly fewer failed XLA compiles than HEAD's blind climb (which
+       pays one per level: 4 compiles to reach L3).
+    """
+    import json
+    import tempfile
+
+    os.environ.setdefault("THUNDER_TPU_RETRY_BACKOFF_S", "0")
+
+    import numpy as np
+    import thunder_tpu as ttpu
+    import thunder_tpu.clang as clang
+    import thunder_tpu.core.prims as tprims
+    from thunder_tpu.analysis import Severity, certify, plan_liveness, verify
+    from thunder_tpu.analysis import schedule as sched_mod
+    from thunder_tpu.core import devices, dtypes
+    from thunder_tpu.core.proxies import TensorProxy
+    from thunder_tpu.core.trace import TraceCtx, from_trace, tracectx
+    from thunder_tpu.distributed import prims as dist
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.observability.instrument import instrument_reports
+
+    n_errors = 0
+    rng = np.random.RandomState(0)
+    cfg = m.name_to_config("gpt-tiny")
+    params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+    idx = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+
+    # -- 1. liveness prediction vs measured high-water ------------------------
+    workloads = [
+        ("gpt-fwd", ttpu.jit(lambda p, i: m.forward(p, i, cfg),
+                             executors=["jax"], instrument="memory"),
+         (params, idx)),
+        ("gpt-fwd+bwd", ttpu.value_and_grad(
+            lambda p, i, t: m.loss_fn(p, i, t, cfg),
+            executors=["jax"], instrument="memory"),
+         (params, idx, tgt)),
+    ]
+    for name, jf, wargs in workloads:
+        jf(*wargs)
+        entry = jf._lc_cs.cache_entries[0]
+        predicted_peak = entry.stats.predicted_peak_bytes
+        rep = next((r for r in instrument_reports(jf)
+                    if r["hook"] == "MemoryHighWater"), None)
+        if predicted_peak is None or rep is None:
+            # The planner is advisory at compile time (degrades to None),
+            # but the smoke's whole job is to gate it: count the failure
+            # instead of crashing the gate script.
+            n_errors += 1
+            print(f"    FAILED: {name}: planner produced no prediction "
+                  f"(predicted_peak={predicted_peak}, memory hook="
+                  f"{'present' if rep else 'absent'})")
+            continue
+        plan = plan_liveness(entry.computation_traces[-1], include_rows=False)
+        if rep["exact"]:
+            predicted, measured, what = predicted_peak, rep["peak_bytes"], "peak"
+        else:
+            # CPU plugin: no bytes_in_use — the hook's estimate is the
+            # cumulative produced-bytes total, compared against the plan's
+            # eager-allocation total (same quantity, statically derived).
+            predicted, measured, what = (
+                plan.eager_alloc_bytes, rep["peak_bytes"], "eager-alloc",
+            )
+        err = abs(predicted - measured) / max(measured, 1)
+        line = (f"{name}: predicted {what} {predicted / 1e6:.2f} MB vs measured "
+                f"{measured / 1e6:.2f} MB ({err * 100:+.1f}%), "
+                f"static peak {predicted_peak / 1e6:.2f} MB")
+        if err > 0.15:
+            n_errors += 1
+            print(f"    FAILED (OOM-misprediction >15%): {line}")
+        else:
+            print(f"    {line}")
+
+    # -- 2. schedule certificate + sanitizer seeded-bads ----------------------
+    print("--- static smoke: fsdp4-tp2 schedule certificate")
+
+    def _cpu_t(shape, name=None):
+        return TensorProxy(name=name, shape=shape, dtype=dtypes.float32,
+                           device=devices.Device("cpu"))
+
+    from thunder_tpu.api import trace_program
+    from thunder_tpu.core.proxies import DistParallelType
+    from thunder_tpu.executors.passes import transform_for_execution
+    from thunder_tpu.extend import resolve_executors
+    from thunder_tpu.transforms.autodiff import grad_transform
+    from thunder_tpu.transforms.common import dce
+
+    w = rng.randn(4, 8).astype(np.float32)  # fsdp shard of a (16, 8) weight
+    x = rng.randn(4, 8).astype(np.float32)
+
+    def fsdp_tp_loss(w_shard, xv):
+        w_full = dist.synchronize(w_shard, "fsdp", 4, "fsdp")
+        h = clang.matmul(xv, clang.transpose(w_full, 0, 1))
+        h = dist.all_reduce(h, "tp", 2)
+        return clang.mean(clang.mul(h, h))
+
+    _, comp = trace_program(fsdp_tp_loss, (w, x), {})
+    comp = dce(comp)
+    comp = grad_transform(comp, return_value=True)
+    extrace = transform_for_execution(comp, resolve_executors(["jax"]))
+    cert = sched_mod.stamp(extrace)
+    axes = set(cert.axis_order)
+    syms = [s.sym for s in cert.sites]
+    if {"fsdp", "tp"} <= axes and "reduce_scatter" in syms:
+        print(f"    certificate OK: {len(cert.sites)} sites on axes "
+              f"{sorted(axes)}, grad reduce_scatter present, "
+              f"{len(cert.movable_sites())} movable")
+    else:
+        n_errors += 1
+        print(f"    FAILED: certificate incomplete (axes={axes}, syms={syms})")
+    if any(d.severity >= Severity.ERROR for d in verify(extrace)):
+        n_errors += 1
+        print("    FAILED: planner rules fired on the clean fsdp-tp trace")
+
+    # Uncertified reorder of two same-axis collectives must be flagged.
+    coll_idx = [s.index for s in cert.sites if s.axis == "fsdp"]
+    if len(coll_idx) >= 2:
+        bad = from_trace(extrace)
+        bs = list(extrace.bound_symbols)
+        i, j = coll_idx[0], coll_idx[1]
+        bs[i], bs[j] = bs[j], bs[i]
+        bad.bound_symbols = bs
+        diags = verify(bad, pass_name="uncertified reorder pass")
+        if any(d.rule == "sched.uncertified-reorder" for d in diags):
+            print("    uncertified same-axis reorder flagged OK")
+        else:
+            n_errors += 1
+            print("    FAILED: uncertified collective reorder NOT flagged")
+    else:
+        n_errors += 1
+        print("    FAILED: expected >=2 fsdp collectives to exercise reorder")
+
+    # Seeded-bad donation/alias traces: each sanitizer rule must fire.
+    def _seeded_bads():
+        t1 = TraceCtx()
+        with tracectx(t1):
+            a = _cpu_t((4, 4))
+            t1.args = (a,)
+            out = clang.mul(a, a)
+            tprims.python_return(out)
+            t1.output = out
+        t1.tags["donated_inputs"] = (a.name,)
+        t1.tags["rerun_reads_inputs"] = True
+        yield "donation.use-after-donation", t1
+
+        t2 = TraceCtx()
+        with tracectx(t2):
+            a = _cpu_t((4, 4))
+            t2.args = (a,)
+            tprims.python_return(a)
+            t2.output = a
+        t2.tags["donated_inputs"] = (a.name,)
+        yield "donation.donated-output", t2
+
+        t3 = TraceCtx()
+        with tracectx(t3):
+            src = _cpu_t((4, 4))
+            dst = _cpu_t((4, 4))
+            t3.args = (src, dst)
+            written = _cpu_t((4, 4))
+        t3.bound_symbols.append(tprims.copy_.bind(src, dst, output=written))
+        with tracectx(t3):
+            tprims.python_return(dst)
+        t3.output = dst
+        yield "alias.entry-aliasing", t3
+
+    for rule_id, trc in _seeded_bads():
+        diags = verify(trc)
+        if any(d.rule == rule_id and d.severity >= Severity.ERROR for d in diags):
+            print(f"    {rule_id} fired on seeded-bad trace OK")
+        else:
+            n_errors += 1
+            print(f"    FAILED: {rule_id} did not fire on its seeded-bad trace")
+
+    # -- 3. planner-guided de-opt ladder under the chaos oom ceiling ----------
+    print("--- static smoke: de-opt ladder jump under oom@<3")
+    from thunder_tpu.analysis.liveness import predict_level_peaks
+
+    xb = rng.randn(100, 64).astype(np.float32)  # batch 100 -> pow2 bucket 128
+    wb = rng.randn(64, 64).astype(np.float32)
+
+    def chain(xv, wv):
+        h = clang.matmul(xv, wv)
+        h = clang.tanh(h)
+        h = clang.matmul(h, wv)
+        return clang.sum(clang.mul(h, h))
+
+    baseline = float(np.asarray(
+        ttpu.jit(chain, executors=["jax"])(xb, wb)
+    ))
+
+    probe = ttpu.jit(chain, cache="symbolic values", symbolic_dims={0: (0,)},
+                     executors=["jax"])
+    probe(xb, wb)
+    probe_entry = probe._lc_cs.cache_entries[0]
+    peaks = predict_level_peaks(
+        probe_entry.computation_traces[-1],
+        sym_spec=probe_entry.sym_spec,
+        true_extents=probe_entry.last_true_extents,
+    )
+    if not (peaks[3] and peaks[1] and peaks[3] < peaks[1]):
+        n_errors += 1
+        print(f"    FAILED: exact-shape peak should undercut padded ({peaks})")
+        print(f"\nlint_traces --static: {n_errors} error(s)")
+        return n_errors
+    capacity = (peaks[1] + peaks[3]) // 2
+    os.environ["THUNDER_TPU_HBM_BYTES"] = str(int(capacity))
+    log = os.path.join(tempfile.mkdtemp(prefix="ttpu_static_"), "events.jsonl")
+    try:
+        jf = ttpu.jit(chain, cache="symbolic values", symbolic_dims={0: (0,)},
+                      executors=["jax"], chaos="oom@<3*inf", events=log)
+        out = float(np.asarray(jf(xb, wb)))
+        cs = jf._lc_cs
+        level = jf._lc_cd._deopt_level
+        deopts = [json.loads(l) for l in open(log)
+                  if json.loads(l).get("kind") == "compile_deopt"]
+        blind_compiles = 1 + 3  # HEAD pays one failed compile per level to L3
+        ok = (
+            abs(out - baseline) < 1e-3 * max(abs(baseline), 1.0)
+            and level == 3
+            and cs.compile_count < blind_compiles
+            and len(deopts) == 1
+            and deopts[0].get("skipped_levels") == [1, 2]
+            and deopts[0].get("predicted_peak_bytes")
+        )
+        if ok:
+            print(f"    ladder jump OK: L0 -> L3 in {cs.compile_count} compiles "
+                  f"(blind HEAD: {blind_compiles}), skipped {deopts[0]['skipped_levels']}, "
+                  f"predicted {deopts[0]['predicted_peak_bytes'] / 1e3:.1f} KB vs "
+                  f"capacity {capacity / 1e3:.1f} KB")
+        else:
+            n_errors += 1
+            print(f"    FAILED: level={level} compiles={cs.compile_count} "
+                  f"(blind={blind_compiles}) deopts={deopts} out={out} "
+                  f"baseline={baseline}")
+    finally:
+        os.environ.pop("THUNDER_TPU_HBM_BYTES", None)
+
+    print(f"\nlint_traces --static: {n_errors} error(s)")
     return n_errors
 
 
@@ -539,8 +807,9 @@ def _chaos_multihost_inner() -> int:
     return n_errors
 
 
-_USAGE = ("usage: lint_traces.py [pattern] | --chaos | --chaos-multihost | "
-          "--multichip | --events <log.jsonl> [...] [--storm-threshold N]")
+_USAGE = ("usage: lint_traces.py [pattern] | --static | --chaos | "
+          "--chaos-multihost | --multichip | --events <log.jsonl> [...] "
+          "[--storm-threshold N]")
 
 
 def main(argv=None) -> int:
@@ -551,6 +820,10 @@ def main(argv=None) -> int:
 
     if "--chaos-multihost" in argv:
         return 1 if _chaos_multihost_smoke() else 0
+
+    if "--static" in argv:
+        print("--- static smoke: liveness prediction vs instrument='memory'")
+        return 1 if _static_smoke() else 0
 
     if "--chaos" in argv:
         return 1 if _chaos_smoke() else 0

@@ -25,7 +25,7 @@ def main():
     from thunder_tpu.observability.profile import profile
 
     _ensure_runtime()
-    jfn, flat_params, idx, tgt, init_s, trace_s, stage_s = build_train(
+    jfn, flat_params, idx, tgt, init_s, trace_s, stage_s, *_static = build_train(
         "open_llama_3b", TRAIN_B, TRAIN_T
     )
 

@@ -180,13 +180,16 @@ class TestEventLog:
                 "compile_id", "fn", "ms", "n_bsyms", "claims",
                 "collective_bytes", "symbolic", "recompile", "staged",
             },
-            # cache (hit|miss verdict on xla_compile) is the one optional
-            # field in the schema; sub-spans carry the bare triple.
+            # Optional fields: cache (hit|miss verdict on xla_compile) and
+            # the static_analysis span's planner summary (ISSUE 10:
+            # predicted_peak_bytes + collective_sites); sub-spans carry the
+            # bare triple.
             "compile_phase": envelope | {"compile_id", "phase", "s"},
         }
+        phase_optional = {"cache", "predicted_peak_bytes", "collective_sites"}
         for r in recs:
             want = golden[r["kind"]]
-            got = set(r) - ({"cache"} if r["kind"] == "compile_phase" else set())
+            got = set(r) - (phase_optional if r["kind"] == "compile_phase" else set())
             assert got == want, (r["kind"], sorted(got ^ want))
         assert all(r["v"] == 1 for r in recs)
         # seq is the per-log line counter

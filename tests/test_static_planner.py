@@ -1,0 +1,699 @@
+"""Static trace planner suite tests (ISSUE 10): liveness goldens vs
+analytic live-sets, measured-vs-predicted peak on the GPT block
+(``instrument="memory"`` cross-check on the CPU plugin), schedule
+certificates for legal/illegal collective reorders, seeded-bad
+donation/alias traces per the PR 1 rule-test convention, and the
+planner-guided de-opt ladder jump."""
+
+import json
+
+import numpy as np
+import pytest
+
+import thunder_tpu as ttpu
+import thunder_tpu.clang as clang
+import thunder_tpu.core.prims as prims
+from thunder_tpu.analysis import (
+    Severity,
+    certify,
+    device_capacity_bytes,
+    memory_report,
+    plan_liveness,
+    predict_level_peaks,
+    verify,
+)
+from thunder_tpu.analysis import schedule as sched_mod
+from thunder_tpu.analysis.liveness import (
+    arg_divisors_from_specs,
+    exact_shape_scale,
+    partition_divisor,
+)
+from thunder_tpu.core import devices, dtypes
+from thunder_tpu.core.proxies import TensorProxy
+from thunder_tpu.core.trace import TraceCtx, from_trace, tracectx
+from thunder_tpu.distributed import prims as dist_prims
+from thunder_tpu.resilience import deopt
+
+
+def _cpu():
+    return devices.Device("cpu")
+
+
+def _t(shape=(4, 4), dtype=dtypes.float32, name=None):
+    return TensorProxy(name=name, shape=shape, dtype=dtype, device=_cpu())
+
+
+F32 = 4  # bytes
+
+
+def _chain_trace():
+    """a, b inputs (64 B each); c = a+b; d = c*c; return d."""
+    trc = TraceCtx()
+    with tracectx(trc):
+        a = _t()
+        b = _t()
+        trc.args = (a, b)
+        c = clang.add(a, b)
+        d = clang.mul(c, c)
+        prims.python_return(d)
+        trc.output = d
+    return trc, a, b
+
+
+class TestLivenessGoldens:
+    def test_analytic_peak_no_donation(self):
+        trc, a, b = _chain_trace()
+        plan = plan_liveness(trc)
+        # Inputs live throughout (128); at d both c (64) and d (64) exist.
+        assert plan.input_bytes == 2 * 16 * F32
+        assert plan.peak_bytes == 4 * 16 * F32
+        assert plan.peak_sym == "mul"
+        assert plan.output_bytes == 16 * F32
+
+    def test_donated_inputs_die_at_last_use(self):
+        trc, a, b = _chain_trace()
+        plan = plan_liveness(trc, donated=(a.name, b.name))
+        # a, b free after c (their last use): peak is a+b+c during the add.
+        assert plan.peak_bytes == 3 * 16 * F32
+        assert plan.donated_names == (a.name, b.name)
+
+    def test_donated_tag_consulted(self):
+        trc, a, b = _chain_trace()
+        trc.tags["donated_inputs"] = (a.name, b.name)
+        assert plan_liveness(trc).peak_bytes == 3 * 16 * F32
+
+    def test_alias_ops_charge_nothing(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t((4, 4))
+            trc.args = (a,)
+            v = clang.reshape(a, (16,))
+            c = clang.mul(v, v)
+            prims.python_return(c)
+            trc.output = c
+        plan = plan_liveness(trc)
+        # The reshape is a view: peak = a + c only.
+        assert plan.peak_bytes == 2 * 16 * F32
+
+    def test_del_carrying_trace_matches_interval_analysis(self):
+        from thunder_tpu.executors.passes import del_last_used, transform_for_execution
+        from thunder_tpu.extend import resolve_executors
+        from thunder_tpu.api import trace_program
+        from thunder_tpu.transforms.common import cse, dce
+
+        def f(x):
+            h = clang.tanh(clang.matmul(x, x))
+            return clang.sum(clang.mul(h, h))
+
+        x = np.ones((8, 8), np.float32)
+        _, comp = trace_program(f, (x,), {})
+        extrace = transform_for_execution(cse(dce(comp)), resolve_executors(["jax"]))
+        no_del = plan_liveness(extrace)
+        with_del = plan_liveness(del_last_used(extrace))
+        assert with_del.peak_bytes == no_del.peak_bytes
+
+    def test_del_of_viewed_root_keeps_buffer_live(self):
+        """A del lands right after a reshape, but the view still holds the
+        buffer — the plan must free at the alias-extended last use, not at
+        the per-name del (else peak under-predicts and the de-opt skip
+        logic's lower-bound premise breaks)."""
+        from thunder_tpu.executors.passes import del_last_used
+
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t((4, 4))
+            trc.args = (a,)
+            t1 = clang.add(a, a)
+            v1 = clang.reshape(t1, (16,))
+            t2 = clang.add(a, a)
+            v2 = clang.reshape(t2, (16,))
+            out = clang.mul(v1, v2)
+            prims.python_return(out)
+            trc.output = out
+        plan = plan_liveness(del_last_used(trc))
+        # At the mul: a + t1 + t2 (held via their views) + out.
+        assert plan.peak_bytes == 4 * 16 * F32
+
+    def test_dtype_awareness(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t((4, 4), dtype=dtypes.bfloat16)
+            trc.args = (a,)
+            c = clang.add(a, a)
+            prims.python_return(c)
+            trc.output = c
+        plan = plan_liveness(trc)
+        assert plan.input_bytes == 16 * 2  # bf16 = 2 bytes
+        assert plan.peak_bytes == 2 * 16 * 2
+
+    def test_sharding_divisors(self):
+        from jax.sharding import PartitionSpec as P
+
+        trc, a, b = _chain_trace()
+        divs = {a.name: 4.0}
+        plan = plan_liveness(trc, arg_divisors=divs)
+        # a counts 16 B (64/4); b, c, d full-size.
+        assert plan.input_bytes == 16 + 64
+        assert partition_divisor(P("fsdp", None), {"fsdp": 4}) == 4.0
+        assert partition_divisor(P(("dp", "fsdp"), None), {"dp": 2, "fsdp": 4}) == 8.0
+        assert partition_divisor(P(), {"fsdp": 4}) == 1.0
+        named = arg_divisors_from_specs(trc, [P("x", None), P()], axis_sizes={"x": 8})
+        assert named == {a.name: 8.0}
+
+    def test_capacity_env_override(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_HBM_BYTES", "12345")
+        assert device_capacity_bytes() == 12345
+
+
+class TestPredictedOOMRule:
+    def _biggish_trace(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t((64, 64))
+            trc.args = (a,)
+            h = clang.matmul(a, a)
+            h = clang.tanh(h)
+            h = clang.mul(h, h)
+            out = clang.sum(h)
+            prims.python_return(out)
+            trc.output = out
+        return trc
+
+    def test_fires_when_over_capacity(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_HBM_BYTES", "1024")
+        diags = verify(self._biggish_trace())
+        found = [d for d in diags if d.rule == "mem.predicted-oom"]
+        assert len(found) == 1
+        assert found[0].severity == Severity.WARNING
+        assert "exceeds" in found[0].message
+
+    def test_silent_under_capacity(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_HBM_BYTES", str(1 << 30))
+        diags = verify(self._biggish_trace())
+        assert [d for d in diags if d.rule == "mem.predicted-oom"] == []
+
+
+@pytest.mark.checks_smoke
+class TestMeasuredCrossCheck:
+    """Predicted vs instrument="memory" on the GPT block (the --static smoke
+    runs the full-size version; this is the tier-1 cross-check)."""
+
+    def test_gpt_block_prediction_within_tolerance(self):
+        from thunder_tpu.models import gpt as m
+        from thunder_tpu.observability.instrument import instrument_reports
+
+        cfg = m.name_to_config("gpt-tiny")
+        params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+        idx = np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (2, 16)).astype(np.int32)
+
+        jf = ttpu.jit(lambda p, i: m.forward(p, i, cfg),
+                      executors=["jax"], instrument="memory")
+        jf(params, idx)
+        entry = jf._lc_cs.cache_entries[0]
+        assert entry.stats.predicted_peak_bytes > 0
+        rep = next(r for r in instrument_reports(jf)
+                   if r["hook"] == "MemoryHighWater")
+        plan = plan_liveness(entry.computation_traces[-1], include_rows=False)
+        if rep["exact"]:
+            predicted, measured = entry.stats.predicted_peak_bytes, rep["peak_bytes"]
+        else:
+            predicted, measured = plan.eager_alloc_bytes, rep["peak_bytes"]
+        assert measured > 0
+        assert abs(predicted - measured) / measured <= 0.15
+
+    def test_memory_report_end_to_end(self):
+        plan = memory_report(
+            lambda a, w: clang.sum(clang.tanh(clang.matmul(a, w))),
+            np.ones((8, 16), np.float32), np.ones((16, 4), np.float32),
+            executors=["jax"],
+        )
+        assert plan.peak_bytes > 0
+        assert plan.peak_bytes >= plan.input_bytes
+        assert "predicted peak" in plan.format()
+
+
+class TestScheduleCertificate:
+    def _two_axis_trace(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            b = _t()
+            trc.args = (a, b)
+            r1 = dist_prims.all_reduce(a, "dp", 4)
+            r2 = dist_prims.all_reduce(b, "tp", 2)  # independent of r1
+            out = clang.add(r1, r2)
+            prims.python_return(out)
+            trc.output = out
+        return trc
+
+    def test_independent_axes_are_movable(self):
+        cert = certify(self._two_axis_trace())
+        assert len(cert.sites) == 2
+        s1, s2 = cert.sites
+        # Both pinned-left by their input producers (trace args: earliest 0),
+        # bounded right by their common consumer.
+        assert s1.latest == s2.index  # r1 may sink past r2 (different axis)
+        assert s2.hoistable           # r2 may hoist before r1
+        assert set(cert.axis_order) == {"dp", "tp"}
+
+    def test_same_axis_collectives_pin_each_other(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            r1 = dist_prims.all_reduce(a, "dp", 4)
+            r2 = dist_prims.all_reduce(a, "dp", 4)  # no data dep on r1
+            out = clang.add(r1, r2)
+            prims.python_return(out)
+            trc.output = out
+        cert = certify(trc)
+        s1, s2 = cert.sites
+        # Data-independent, but the per-axis order still pins them.
+        assert s1.latest < s2.index or s1.latest == s2.index - 1
+        assert s2.earliest > s1.index
+
+    def test_wait_pairing_constrains_placement(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            fut = dist_prims.all_gather(a, "dp", 4, async_op=True)
+            got = dist_prims.wait(fut)
+            out = clang.mul(got, got)
+            prims.python_return(out)
+            trc.output = out
+        cert = certify(trc)
+        gather = cert.site_at(0)
+        wait = cert.site_at(1)
+        assert wait.earliest > gather.index  # wait never crosses its future
+
+    def test_inplace_write_is_an_anti_dependency(self):
+        # copy_ overwrites the collective's operand: the site must not be
+        # certified hoistable above a mutation it reads after, nor sinkable
+        # below one that would overwrite what it reads.
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            src = _t()
+            trc.args = (a, src)
+            written = _t()
+        trc.bound_symbols.append(prims.copy_.bind(src, a, output=written))
+        with tracectx(trc):
+            r = dist_prims.all_reduce(a, "dp", 4)
+            out = clang.mul(r, r)
+            prims.python_return(out)
+            trc.output = out
+        cert = certify(trc)
+        site = cert.sites[0]
+        assert site.earliest == 1  # pinned below the copy_ at index 0
+        assert 0 in site.deps_before
+
+        trc2 = TraceCtx()
+        with tracectx(trc2):
+            a = _t()
+            src = _t()
+            trc2.args = (a, src)
+            r = dist_prims.all_reduce(a, "dp", 4)
+            written = _t()
+        trc2.bound_symbols.append(prims.copy_.bind(src, a, output=written))
+        with tracectx(trc2):
+            out = clang.mul(r, written)
+            prims.python_return(out)
+            trc2.output = out
+        cert2 = certify(trc2)
+        site2 = cert2.sites[0]
+        assert site2.latest == 0  # pinned above the copy_ at index 1
+        assert 1 in site2.deps_after
+
+    def test_illegal_reorder_flagged_and_attributed(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            r1 = dist_prims.all_reduce(a, "dp", 4)
+            r2 = dist_prims.all_reduce(a, "dp", 4)
+            out = clang.add(r1, r2)
+            prims.python_return(out)
+            trc.output = out
+        sched_mod.stamp(trc)
+        bad = from_trace(trc)
+        bs = list(trc.bound_symbols)
+        bs[0], bs[1] = bs[1], bs[0]
+        bad.bound_symbols = bs
+        diags = verify(bad, pass_name="evil reorder pass")
+        found = [d for d in diags if d.rule == "sched.uncertified-reorder"]
+        assert len(found) == 1
+        assert found[0].severity == Severity.ERROR
+        assert found[0].pass_name == "evil reorder pass"
+        # The flagged order must NOT become the new baseline: a re-verify of
+        # the same trace fires again (only schedule.recertify may bless it).
+        again = verify(bad, pass_name="evil reorder pass")
+        assert any(d.rule == "sched.uncertified-reorder" for d in again)
+
+    def test_recertified_reorder_is_clean(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            r1 = dist_prims.all_reduce(a, "dp", 4)
+            r2 = dist_prims.all_reduce(a, "dp", 4)
+            out = clang.add(r1, r2)
+            prims.python_return(out)
+            trc.output = out
+        sched_mod.stamp(trc)
+        moved = from_trace(trc)
+        bs = list(trc.bound_symbols)
+        bs[0], bs[1] = bs[1], bs[0]
+        moved.bound_symbols = bs
+        sched_mod.recertify(moved)  # the pass proves + re-stamps its schedule
+        diags = verify(moved, pass_name="certified scheduler",
+                       disable={"ssa.use-before-def"})
+        assert [d for d in diags if d.rule == "sched.uncertified-reorder"] == []
+
+    def test_additions_and_deletions_are_legal(self):
+        trc = self._two_axis_trace()
+        sched_mod.stamp(trc)
+        grown = from_trace(trc)
+        grown.bound_symbols = list(trc.bound_symbols)
+        with tracectx(grown):
+            extra = dist_prims.all_reduce(grown.args[0], "dp", 4)
+        # Insert the new collective before the return.
+        grown.bound_symbols.insert(3, grown.bound_symbols.pop())
+        diags = verify(grown, pass_name="grad-ish pass")
+        assert [d for d in diags if d.rule == "sched.uncertified-reorder"] == []
+
+    def test_axis_labels_for_watchdog(self):
+        cert = certify(self._two_axis_trace())
+        labels = cert.axis_labels()
+        assert labels["dp"] == ["L0.all_reduce"]
+        assert labels["tp"] == ["L1.all_reduce"]
+
+
+class TestDonationRules:
+    """Seeded-bad / clean pairs per the PR 1 convention."""
+
+    def test_use_after_donation_fires_once(self):
+        trc, a, b = _chain_trace()
+        trc.tags["donated_inputs"] = (a.name,)
+        trc.tags["rerun_reads_inputs"] = True
+        found = [d for d in verify(trc) if d.rule == "donation.use-after-donation"]
+        assert len(found) == 1
+        assert found[0].severity == Severity.ERROR
+
+    def test_donation_without_rerun_is_clean(self):
+        trc, a, b = _chain_trace()
+        trc.tags["donated_inputs"] = (a.name,)
+        assert [d for d in verify(trc) if d.rule.startswith("donation.")] == []
+
+    def test_donated_output_fires_once(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            prims.python_return(a)
+            trc.output = a
+        trc.tags["donated_inputs"] = (a.name,)
+        found = [d for d in verify(trc) if d.rule == "donation.donated-output"]
+        assert len(found) == 1
+        assert found[0].severity == Severity.ERROR
+
+    def test_donated_output_fires_through_view(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t((4, 4))
+            trc.args = (a,)
+            v = clang.reshape(a, (16,))
+            prims.python_return(v)
+            trc.output = v
+        trc.tags["donated_inputs"] = (a.name,)
+        found = [d for d in verify(trc) if d.rule == "donation.donated-output"]
+        assert len(found) == 1
+        assert "view" in found[0].message
+
+    def test_entry_aliasing_fires_through_view(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            src = _t((4, 4))
+            dst = _t((4, 4))
+            trc.args = (src, dst)
+            written = _t((4, 4))
+        trc.bound_symbols.append(prims.copy_.bind(src, dst, output=written))
+        with tracectx(trc):
+            v = clang.reshape(dst, (16,))
+            prims.python_return(v)
+        trc.output = v
+        found = [d for d in verify(trc) if d.rule == "alias.entry-aliasing"]
+        assert len(found) == 1
+        assert "view" in found[0].message
+
+    def test_entry_aliasing_fires_once_with_index(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            src = _t()
+            dst = _t()
+            trc.args = (src, dst)
+            written = _t()
+        trc.bound_symbols.append(prims.copy_.bind(src, dst, output=written))
+        with tracectx(trc):
+            prims.python_return(dst)
+        trc.output = dst
+        found = [d for d in verify(trc) if d.rule == "alias.entry-aliasing"]
+        assert len(found) == 1
+        assert found[0].bsym_index == 0
+
+    def test_functionalized_inplace_is_clean(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            src = _t()
+            dst = _t()
+            trc.args = (src, dst)
+            written = _t()
+        trc.bound_symbols.append(prims.copy_.bind(src, dst, output=written))
+        with tracectx(trc):
+            prims.python_return(written)
+        trc.output = written
+        assert [d for d in verify(trc) if d.rule == "alias.entry-aliasing"] == []
+
+    def test_unstaged_entry_never_marked_donating(self):
+        """Instrumented entries run unstaged (no jax.jit, no donation) —
+        the donation tag and predicted peak must price what really runs."""
+        def f(x):
+            return clang.sum(clang.mul(x, x))
+
+        jf = ttpu.jit(f, cache="symbolic values", symbolic_dims={0: (0,)},
+                      executors=["jax"], instrument="memory")
+        jf(np.ones((100, 8), np.float32))
+        trc = jf._lc_cs.cache_entries[0].computation_traces[-1]
+        assert tuple(trc.tags.get("donated_inputs") or ()) == ()
+
+    def test_rerun_capable_entry_never_donates(self):
+        """The api-level invariant the rules certify: an on_nan rerun entry
+        compiles with donation off and the tags say so."""
+        def f(x):
+            return clang.sum(clang.mul(x, x))
+
+        jf = ttpu.jit(f, cache="symbolic values", symbolic_dims={0: (0,)},
+                      executors=["jax"], on_nan="rerun-instrumented")
+        jf(np.ones((100, 8), np.float32))
+        trc = jf._lc_cs.cache_entries[0].computation_traces[-1]
+        assert trc.tags.get("rerun_reads_inputs") is True
+        assert tuple(trc.tags.get("donated_inputs") or ()) == ()
+        assert not any(
+            d.rule.startswith("donation.") for d in verify(trc)
+        )
+
+    def test_sdc_guard_rejects_donating_step(self, tmp_path):
+        from thunder_tpu.resilience.preemption import CheckpointManager, run_training
+
+        def step(state):
+            return state, 0.0
+
+        step._thunder_donates = True
+        with pytest.raises(ValueError, match="non-donating"):
+            run_training(step, {}, 1,
+                         manager=CheckpointManager(str(tmp_path)), sdc_guard=True)
+
+
+class TestStaticPhaseWiring:
+    def test_compile_records_static_analysis_phase(self, tmp_path):
+        log = str(tmp_path / "ev.jsonl")
+
+        def f(x):
+            return clang.sum(clang.tanh(x))
+
+        jf = ttpu.jit(f, executors=["jax"], events=log)
+        jf(np.ones((4, 4), np.float32))
+        info = ttpu.cache_info(jf)
+        assert "static_analysis" in info["compile_phase_seconds"]
+        entry = info["entries"][0]
+        assert entry["predicted_peak_bytes"] > 0
+        recs = [json.loads(l) for l in open(log)]
+        span = next(r for r in recs if r.get("kind") == "compile_phase"
+                    and r.get("phase") == "static_analysis")
+        assert span["predicted_peak_bytes"] == entry["predicted_peak_bytes"]
+        assert span["collective_sites"] == 0
+
+    def test_symbolic_entry_donation_tag_matches_marks(self):
+        def f(x, w):
+            return clang.sum(clang.matmul(x, w))
+
+        jf = ttpu.jit(f, cache="symbolic values", symbolic_dims={0: (0,)},
+                      executors=["jax"])
+        jf(np.ones((100, 8), np.float32), np.ones((8, 4), np.float32))
+        entry = jf._lc_cs.cache_entries[0]
+        trc = entry.computation_traces[-1]
+        donated = tuple(trc.tags.get("donated_inputs") or ())
+        import jax
+
+        if jax.default_backend() == "cpu":
+            assert donated == ()  # donation is off on CPU — tags say what ran
+        else:
+            assert len(donated) == 1
+
+    def test_watchdog_error_carries_schedule(self):
+        from thunder_tpu.resilience.watchdog import CollectiveTimeoutError
+
+        err = CollectiveTimeoutError(
+            "step", 1.0, ["L3.all_reduce"], 2,
+            schedule={"dp": ["L1.synchronize", "L3.all_reduce"]},
+        )
+        assert err.schedule == {"dp": ["L1.synchronize", "L3.all_reduce"]}
+        assert "certified order" in str(err)
+        assert "L1.synchronize -> L3.all_reduce" in str(err)
+
+
+class TestPlannerGuidedDeopt:
+    def test_exact_shape_scale(self):
+        class Spec:
+            marks = {0: {0: (64, 128, 0)}}
+
+        x = _t((128, 32))
+        assert exact_shape_scale(Spec(), {0: 100}, [x]) == pytest.approx(100 / 128)
+        assert exact_shape_scale(None, {0: 100}, [x]) is None
+        assert exact_shape_scale(Spec(), None, [x]) is None
+        assert exact_shape_scale(Spec(), {0: 100}, None) is None
+
+    def test_exact_shape_scale_is_a_byte_ratio(self):
+        # Two marked dims of one leaf MULTIPLY (100·100)/(128·128), not the
+        # linear (100+100)/(128+128) a sum-of-extents model would give.
+        class Spec2:
+            marks = {0: {0: (64, 128, 0), 1: (64, 128, 1)}}
+
+        y = _t((128, 128))
+        assert exact_shape_scale(Spec2(), {0: 100, 1: 100}, [y]) == \
+            pytest.approx((100 * 100) / (128 * 128))
+
+        # A tiny marked leaf cannot dilute a huge one: bytes weight the mix.
+        class Spec3:
+            marks = {0: {0: (64, 128, 0)}, 1: {0: (0, 128, 1)}}
+
+        big = _t((128, 512))
+        small = _t((128,))
+        got = exact_shape_scale(Spec3(), {0: 100, 1: 10}, [big, small])
+        big_b, small_b = 128 * 512 * 4, 128 * 4
+        expect = (big_b * 100 / 128 + small_b * 10 / 128) / (big_b + small_b)
+        assert got == pytest.approx(expect)
+        assert got == pytest.approx(100 / 128, rel=0.01)  # big leaf dominates
+
+    def test_choose_level_skips_proven_oom(self):
+        peaks = {1: 1000, 2: 1000, 3: 500}
+        level, predicted, skipped = deopt._choose_level(peaks, 700, 0)
+        assert (level, predicted, skipped) == (3, 500, [1, 2])
+        # Unknown peaks are never skipped.
+        level, predicted, skipped = deopt._choose_level({1: None}, 700, 0)
+        assert (level, skipped) == (1, [])
+        # Nothing fits: blind single-step climb with NO prediction attached
+        # (the compile_deopt event must not look planner-guided).
+        level, predicted, skipped = deopt._choose_level(
+            {1: 900, 2: 900, 3: 900}, 700, 0)
+        assert (level, predicted, skipped) == (1, None, [])
+
+    def test_oom_level_target_seam(self):
+        from thunder_tpu.resilience import chaos
+
+        with chaos.chaos_scope("oom@<2*inf"):
+            with pytest.raises(chaos.InjectedOOMError):
+                chaos.run_seam(deopt_level=0)
+            with pytest.raises(chaos.InjectedOOMError):
+                chaos.run_seam(deopt_level=1)
+            chaos.run_seam(deopt_level=2)  # at the ceiling: no injection
+
+    def test_ladder_jumps_to_fitting_level(self, monkeypatch, tmp_path):
+        """The acceptance scenario in miniature (the --static smoke runs the
+        full assertion): oom@<3 + a capacity between the padded and exact
+        peaks ⇒ one compile_deopt straight to L3, skipping L1/L2."""
+        monkeypatch.setenv("THUNDER_TPU_RETRY_BACKOFF_S", "0")
+        rng = np.random.RandomState(0)
+        xb = rng.randn(100, 32).astype(np.float32)
+        wb = rng.randn(32, 32).astype(np.float32)
+
+        def chain(xv, wv):
+            h = clang.tanh(clang.matmul(xv, wv))
+            return clang.sum(clang.mul(h, h))
+
+        baseline = float(np.asarray(ttpu.jit(chain, executors=["jax"])(xb, wb)))
+
+        probe = ttpu.jit(chain, cache="symbolic values",
+                         symbolic_dims={0: (0,)}, executors=["jax"])
+        probe(xb, wb)
+        pe = probe._lc_cs.cache_entries[0]
+        peaks = predict_level_peaks(
+            pe.computation_traces[-1], sym_spec=pe.sym_spec,
+            true_extents=pe.last_true_extents,
+        )
+        assert peaks[3] < peaks[1]
+        monkeypatch.setenv("THUNDER_TPU_HBM_BYTES",
+                           str((peaks[1] + peaks[3]) // 2))
+
+        log = str(tmp_path / "ev.jsonl")
+        jf = ttpu.jit(chain, cache="symbolic values", symbolic_dims={0: (0,)},
+                      executors=["jax"], chaos="oom@<3*inf", events=log)
+        out = float(np.asarray(jf(xb, wb)))
+        assert out == pytest.approx(baseline, rel=1e-5)
+        assert jf._lc_cd._deopt_level == 3
+        # One failed compile + one L3 recompile — blind climbing pays four.
+        assert jf._lc_cs.compile_count == 2
+        deopts = [json.loads(l) for l in open(log)
+                  if json.loads(l).get("kind") == "compile_deopt"]
+        assert len(deopts) == 1
+        assert deopts[0]["level"] == 3
+        assert deopts[0]["skipped_levels"] == [1, 2]
+        assert deopts[0]["predicted_peak_bytes"] == peaks[3]
+        assert deopts[0]["capacity_bytes"] == (peaks[1] + peaks[3]) // 2
+
+    def test_predict_level_peaks_unmarked_entry(self):
+        trc, a, b = _chain_trace()
+        peaks = predict_level_peaks(trc)
+        assert peaks[0] == peaks[1] == peaks[2] == peaks[3]
+
+    def test_bucketing_unknown_forces_l3_unprovable(self):
+        # A symbolic-cache function failing before its entry exists: the
+        # planner may hold a padded trace without knowing it — L3 must stay
+        # unknown (never skipped), not inherit L1's "proven" peak.
+        trc, a, b = _chain_trace()
+        peaks = predict_level_peaks(trc, bucketing_unknown=True)
+        assert peaks[3] is None and peaks[1] is not None
+
+    def test_l3_prediction_shrinks_marked_inputs_too(self):
+        # L3 recompiles with exact shapes: the marked INPUT arrives smaller
+        # as well, so the L3 peak must undercut inputs+scaled-activations
+        # computed at padded input size (lower-bound premise of the skip).
+        class Spec:
+            marks = {0: {0: (64, 128, 0)}}
+
+        trc = TraceCtx()
+        with tracectx(trc):
+            x = _t((128, 64))
+            trc.args = (x,)
+            h = clang.mul(x, x)
+            out = clang.sum(h)
+            prims.python_return(out)
+            trc.output = out
+        peaks = predict_level_peaks(trc, sym_spec=Spec(), true_extents={0: 100})
+        no_don = peaks[1]
+        in_b = 128 * 64 * F32
+        scale = 100 / 128
+        expect = int(in_b * scale + (no_don - in_b) * scale)
+        assert peaks[3] == expect
+        assert peaks[3] < int(in_b + (no_don - in_b) * scale)

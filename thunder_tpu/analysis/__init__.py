@@ -11,6 +11,11 @@ rules checks the invariants every transform pass must preserve —
 - ``names.*``         name-registry hygiene
 - ``dist.*``          collective mesh-axis/group consistency, future/wait pairing,
                       fw/bw collective balance
+- ``donation.*``      donated-buffer hazards (rerun paths reading donated
+                      inputs, donated inputs returned as outputs)
+- ``mem.*``           predicted peak HBM vs device capacity (liveness planner)
+- ``sched.*``         per-axis collective ordering vs the stamped schedule
+                      certificate
 
 Pipeline wiring: with ``THUNDER_TPU_CHECKS=1`` (or ``jit(debug_checks=True)``)
 every pass's ``wrap_in_trace_provenance``/``mark`` runs :func:`verify_or_raise`
@@ -44,6 +49,20 @@ from thunder_tpu.analysis.cost import (  # noqa: F401
     trace_cost,
 )
 from thunder_tpu.analysis.events import format_replay, merge_event_logs, replay_events  # noqa: F401
+from thunder_tpu.analysis.liveness import (  # noqa: F401
+    MemoryPlan,
+    arg_divisors_from_specs,
+    device_capacity_bytes,
+    memory_report,
+    plan_liveness,
+    predict_level_peaks,
+)
+from thunder_tpu.analysis.schedule import (  # noqa: F401
+    CollectiveSite,
+    ScheduleCertificate,
+    certify,
+    recertify,
+)
 from thunder_tpu.analysis.registry import (  # noqa: F401
     Rule,
     all_rules,

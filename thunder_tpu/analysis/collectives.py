@@ -33,10 +33,17 @@ _AXIS_ONLY_COLLECTIVES = {DistOpIDs.PPERMUTE, DistOpIDs.MASK_TO_RANK}
 _COLLECTIVE_IDS = _GROUPED_COLLECTIVES | _AXIS_ONLY_COLLECTIVES
 
 
-def _collective_axis(bsym) -> Optional[str]:
+def collective_axis_of(bsym) -> Optional[str]:
+    """The mesh-axis operand of a collective bsym — THE one copy of the
+    (input, axis, group_size, ...) calling convention, shared by the dist.*
+    rules here and the schedule certificate (analysis/schedule.py). May
+    return a malformed (non-str) value; ``dist.axis`` reports those."""
     if len(bsym.args) > 1:
         return bsym.args[1]
     return bsym.kwargs.get("axis")
+
+
+_collective_axis = collective_axis_of
 
 
 def _collective_group_size(bsym):

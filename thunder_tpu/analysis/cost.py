@@ -65,6 +65,11 @@ class DeviceSpec:
     peak_flops: dict[str, float]
     hbm_bw: float
     ici_bw: float = 0.0
+    # Per-chip HBM capacity in bytes (datasheet; the runtime reserves a
+    # fraction — analysis/liveness.device_capacity_bytes prefers the live
+    # backend's bytes_limit and the THUNDER_TPU_HBM_BYTES override). 0 means
+    # unknown: the liveness planner's fit checks are skipped.
+    hbm_bytes: float = 0.0
 
     def peak_for(self, dtype: Any) -> float:
         return self.peak_flops.get(_dtype_class(dtype), self.peak_flops["bf16"])
@@ -87,17 +92,19 @@ def _dtype_class(dtype: Any) -> str:
 # host-platform tests still classify sensibly.
 DEVICE_SPECS: dict[str, DeviceSpec] = {
     "v5e": DeviceSpec("v5e", {"bf16": 197e12, "f32": 98.5e12, "int8": 394e12},
-                      hbm_bw=819e9, ici_bw=186e9),
+                      hbm_bw=819e9, ici_bw=186e9, hbm_bytes=16e9),
     "v5p": DeviceSpec("v5p", {"bf16": 459e12, "f32": 229.5e12, "int8": 918e12},
-                      hbm_bw=2765e9, ici_bw=600e9),
+                      hbm_bw=2765e9, ici_bw=600e9, hbm_bytes=95e9),
     "v4": DeviceSpec("v4", {"bf16": 275e12, "f32": 137.5e12, "int8": 275e12},
-                     hbm_bw=1228e9, ici_bw=300e9),
+                     hbm_bw=1228e9, ici_bw=300e9, hbm_bytes=32e9),
     "v6e": DeviceSpec("v6e", {"bf16": 918e12, "f32": 459e12, "int8": 1836e12},
-                      hbm_bw=1640e9, ici_bw=448e9),
+                      hbm_bw=1640e9, ici_bw=448e9, hbm_bytes=32e9),
     "a100": DeviceSpec("a100", {"bf16": 312e12, "f32": 19.5e12, "int8": 624e12},
-                       hbm_bw=1555e9, ici_bw=600e9),
+                       hbm_bw=1555e9, ici_bw=600e9, hbm_bytes=80e9),
+    # Host RAM is not a fixed datasheet number; 0 = capacity unknown, so the
+    # liveness fit checks defer to memory_stats / THUNDER_TPU_HBM_BYTES.
     "cpu": DeviceSpec("cpu", {"bf16": 2e11, "f32": 2e11, "int8": 4e11},
-                      hbm_bw=5e10, ici_bw=1e10),
+                      hbm_bw=5e10, ici_bw=1e10, hbm_bytes=0.0),
 }
 
 

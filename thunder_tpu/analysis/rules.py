@@ -210,6 +210,117 @@ def inplace_hazard(ctx: VerifyContext) -> None:
 
 
 # =============================================================================
+# (3b) Donation / entry-aliasing sanitizer (ISSUE 10)
+#
+# The compile pipeline stamps donation metadata on the claimed execution
+# trace (api._compile_entry_impl → tags["donated_inputs"] naming the input
+# proxies whose buffers XLA may reuse, tags["rerun_reads_inputs"] when the
+# entry can re-run those same buffers unstaged: the on_nan
+# "rerun-instrumented" guard and the SDC re-run both do). These rules turn
+# the PR 6/9 by-convention invariants ("rerun paths never read donated
+# buffers", "donate=False under sdc_guard") into statically checked ones.
+# =============================================================================
+
+
+@register_rule(
+    "donation.use-after-donation",
+    "No rerun-capable entry donates the input buffers its rerun would re-read",
+)
+def use_after_donation(ctx: VerifyContext) -> None:
+    donated = ctx.trace.tags.get("donated_inputs") or ()
+    if not donated or not ctx.trace.tags.get("rerun_reads_inputs"):
+        return
+    sample = ", ".join(list(donated)[:4]) + ("…" if len(donated) > 4 else "")
+    ctx.report(
+        "donation.use-after-donation",
+        Severity.ERROR,
+        f"entry re-runs its inputs unstaged (on_nan rerun / SDC re-run) but "
+        f"donates {len(donated)} input buffer(s) ({sample}) — XLA deletes "
+        "donated buffers after the staged run, so the re-run would read freed "
+        "memory",
+        hint="disable donation for rerun-capable entries "
+        "(api._compile_entry_impl does; a pass re-enabling it must clear the "
+        "rerun_reads_inputs tag)",
+    )
+
+
+def _alias_root_fn(ctx: VerifyContext):
+    """name -> root-buffer name through the view chain — the SAME alias
+    model the liveness planner uses (one shared helper), so a hazard hidden
+    behind a view is still a hazard and the sanitizer can never disagree
+    with the planner about what aliases what."""
+    from thunder_tpu.analysis.liveness import alias_root_fn
+
+    return alias_root_fn(ctx.bsyms)
+
+
+@register_rule(
+    "donation.donated-output",
+    "No donated input buffer (or a view of one) is returned as a trace output",
+)
+def donated_output(ctx: VerifyContext) -> None:
+    donated = set(ctx.trace.tags.get("donated_inputs") or ())
+    if not donated:
+        return
+    root = _alias_root_fn(ctx)
+    for out_name in sorted(ctx.output_names):
+        r = root(out_name)
+        if r in donated:
+            via = "" if r == out_name else f" (via view {out_name!r})"
+            ctx.report(
+                "donation.donated-output",
+                Severity.ERROR,
+                f"input {r!r} is donated to XLA but its buffer is a trace "
+                f"output{via} — the caller would receive a buffer the "
+                "executable may already have reused",
+                hint="drop the leaf from the donate set, or return a copy",
+            )
+
+
+@register_rule(
+    "alias.entry-aliasing",
+    "No in-place op mutates a trace input that is also (a view of) a trace output",
+)
+def entry_aliasing(ctx: VerifyContext) -> None:
+    """The across-entry alias hazard: an input mutated in place AND returned
+    (directly or through a view) means the caller's buffer and the entry's
+    output alias — a later entry (or the caller) observes the mutation
+    through a value it believes is functional."""
+    from thunder_tpu.core.proxies import Proxy
+
+    root = None
+    for i, bsym in enumerate(ctx.bsyms):
+        if not bsym.has_tag(OpTags.IN_PLACE):
+            continue
+        idx = INPLACE_MUTATED_ARG.get(bsym.sym.id, 0)
+        if idx >= len(bsym.args) or not isinstance(bsym.args[idx], Proxy):
+            continue
+        dst = bsym.args[idx]
+        if root is None:
+            root = _alias_root_fn(ctx)
+        # The mutated DESTINATION may itself be a view of an input — the
+        # caller's buffer is what gets written either way.
+        dst_root = root(dst.name)
+        if dst_root not in ctx.input_names:
+            continue
+        escaping = next(
+            (n for n in sorted(ctx.output_names) if root(n) == dst_root), None
+        )
+        if escaping is not None:
+            via = "" if escaping == dst_root else f" (through view {escaping!r})"
+            ctx.report(
+                "alias.entry-aliasing",
+                Severity.ERROR,
+                f"{bsym.sym.qualname} mutates trace input {dst_root!r} in place "
+                f"and that buffer is a trace output{via} — the mutation "
+                "aliases across the entry boundary",
+                bsym_index=i,
+                hint="functionalize: return the op's output proxy instead of "
+                "the mutated input",
+            )
+
+
+# =============================================================================
 # (4) DCE safety & orphan detection
 # =============================================================================
 

@@ -1,0 +1,377 @@
+"""Collective-schedule safety analyzer: happens-before over dist_prims.
+
+The *scheduling* third of the static trace planner suite (ISSUE 10). Every
+host of an SPMD job executes the same trace, so collectives complete only
+when all hosts reach them **in the same order** — two collectives on one
+mesh axis that different hosts issue in different orders deadlock the ICI.
+Any scheduler that wants to sink or hoist a collective (the compute/comm
+overlap work, ROADMAP 5) therefore needs a proof that the move preserves:
+
+1. data dependencies (the collective's operands exist, its consumers wait);
+2. future/wait pairing (a ``wait`` never crosses before its future's start);
+3. per-axis program order between collectives (the cross-host agreement
+   invariant — the one a single-trace verifier can actually certify).
+
+:func:`certify` builds that proof as a :class:`ScheduleCertificate`: for
+each collective dispatch site, the legal placement interval
+``[earliest, latest]`` under the three constraints, plus the per-axis
+program order and its fingerprint. Passes that legally reorder collectives
+re-stamp the trace via :func:`recertify`; the ``sched.uncertified-reorder``
+verifier rule compares every pass output against the stamped order
+(``trace.tags["collective_order"]``, inherited through ``from_trace``) and
+attributes any uncertified divergence to the pass that introduced it.
+
+Consumers: the future overlap scheduler (ROADMAP 5) reads the movable
+ranges; the collective watchdog (``resilience/watchdog.py``) attaches the
+per-axis order to its :class:`~thunder_tpu.resilience.watchdog.
+CollectiveTimeoutError` so a timeout names not just the pending line but
+the collectives that must already have completed before it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Optional
+
+from thunder_tpu.analysis.context import VerifyContext
+from thunder_tpu.analysis.diagnostics import Severity
+from thunder_tpu.analysis.registry import register_rule
+from thunder_tpu.core.prims import PrimIDs
+from thunder_tpu.core.trace import TraceCtx
+
+
+def _collective_axis(bsym) -> Optional[str]:
+    """Axis of a collective site for scheduling purposes: the shared
+    calling-convention helper (analysis/collectives.collective_axis), with
+    two schedule-specific guards — a wait pairs with its future rather than
+    an axis slot, and a malformed non-str axis (dist.axis reports it) has
+    no ordering lane."""
+    from thunder_tpu.analysis.collectives import collective_axis_of
+    from thunder_tpu.distributed.prims import DistOpIDs
+
+    if bsym.sym.id is DistOpIDs.WAIT:
+        return None
+    ax = collective_axis_of(bsym)
+    return ax if isinstance(ax, str) else None
+
+
+def _site_key(index: int, bsym, axis: Optional[str]) -> str:
+    """Stable identity of a collective across passes: sym name + axis +
+    output proxy name (from_trace shares the name pool, so output names
+    survive pass rewrites that don't rebuild the op)."""
+    out = next(iter(bsym.flat_proxy_outs), None)
+    out_name = getattr(out, "name", f"@{index}")
+    return f"{bsym.sym.name}[{axis or '-'}]->{out_name}"
+
+
+@dataclass
+class CollectiveSite:
+    """One collective dispatch site and its legal placement interval."""
+
+    index: int
+    sym: str
+    axis: Optional[str]
+    key: str
+    line: str
+    earliest: int          # first bsym index the site may legally occupy
+    latest: int            # last bsym index the site may legally occupy
+    deps_before: tuple = ()   # bsym indexes that must precede (data + axis)
+    deps_after: tuple = ()    # bsym indexes that must follow
+
+    @property
+    def hoistable(self) -> bool:
+        return self.earliest < self.index
+
+    @property
+    def sinkable(self) -> bool:
+        return self.latest > self.index
+
+    def label(self) -> str:
+        return f"L{self.index}.{self.sym}"
+
+
+@dataclass
+class ScheduleCertificate:
+    """The proof object: per-site movable ranges + the per-axis order whose
+    preservation is the cross-host safety invariant."""
+
+    trace_name: str
+    pass_name: Optional[str]
+    sites: list = field(default_factory=list)
+    axis_order: dict = field(default_factory=dict)  # axis -> (site key, ...)
+    fingerprint: str = ""
+
+    def site_at(self, index: int) -> Optional[CollectiveSite]:
+        return next((s for s in self.sites if s.index == index), None)
+
+    def movable_sites(self) -> list:
+        return [s for s in self.sites if s.sinkable or s.hoistable]
+
+    def axis_labels(self) -> dict:
+        """{axis: [L<i>.<sym>, ...]} — the watchdog's pending-line context:
+        everything left of a pending collective must already have completed
+        on every healthy host. Memoized: the certificate is immutable once
+        built and this sits on the per-dispatch watchdog path."""
+        cached = getattr(self, "_axis_labels_cache", None)
+        if cached is not None:
+            return cached
+        by_index = {s.key: s for s in self.sites}
+        cached = {
+            axis: [by_index[k].label() for k in keys if k in by_index]
+            for axis, keys in self.axis_order.items()
+        }
+        self._axis_labels_cache = cached
+        return cached
+
+    def legal_order(self, new_axis_order: dict) -> bool:
+        """Whether another trace's per-axis order is a legal evolution of
+        this certificate's: sites present in both keep their relative order
+        per axis (additions and deletions are fine — grad transforms add
+        reduce_scatters, DCE drops dead collectives)."""
+        for axis, old in self.axis_order.items():
+            new = new_axis_order.get(axis, ())
+            pos = {k: p for p, k in enumerate(new)}
+            common = [pos[k] for k in old if k in pos]
+            if common != sorted(common):
+                return False
+        return True
+
+    def format(self) -> str:
+        lines = [
+            f"schedule certificate [{self.trace_name}"
+            + (f" after {self.pass_name}" if self.pass_name else "")
+            + f"]: {len(self.sites)} collective site(s), "
+            f"fingerprint {self.fingerprint[:12]}"
+        ]
+        for s in self.sites:
+            move = []
+            if s.hoistable:
+                move.append(f"hoistable to L{s.earliest}")
+            if s.sinkable:
+                move.append(f"sinkable to L{s.latest}")
+            lines.append(
+                f"  {s.label():<24} axis={s.axis or '-':<6} "
+                + (", ".join(move) if move else "pinned")
+            )
+        for axis, keys in sorted(self.axis_order.items()):
+            lines.append(f"  order[{axis}]: " + " -> ".join(keys))
+        return "\n".join(lines)
+
+    def __str__(self) -> str:
+        return self.format()
+
+
+def _axis_key_order(bsyms) -> dict:
+    """{axis: (site key, ...)} in program order — the comparison object the
+    ``sched.uncertified-reorder`` rule stamps and checks."""
+    from thunder_tpu.distributed.prims import is_collective_bsym
+
+    order: dict[str, list] = {}
+    for i, bsym in enumerate(bsyms):
+        if not is_collective_bsym(bsym):
+            continue
+        axis = _collective_axis(bsym)
+        if axis is None:
+            continue
+        order.setdefault(axis, []).append(_site_key(i, bsym, axis))
+    return {a: tuple(ks) for a, ks in order.items()}
+
+
+def certify(trace: TraceCtx, *, ctx: Optional[VerifyContext] = None) -> ScheduleCertificate:
+    """Build the :class:`ScheduleCertificate` for ``trace``.
+
+    Placement intervals: ``earliest`` is one past the last producer of any
+    operand (and the previous same-axis collective, and any earlier
+    in-place mutation of an operand's buffer); ``latest`` is one before the
+    first consumer of any output (and the next same-axis collective, and
+    any later in-place mutation of an operand's buffer — anti-dependencies:
+    moving a read across a ``copy_`` changes which value it reads); an
+    output that is a trace output pins ``latest`` to the return. DEL sites
+    do not count as consumers (a sunk collective's del sinks with it)."""
+    from thunder_tpu.analysis.liveness import alias_root_fn
+    from thunder_tpu.analysis.rules import INPLACE_MUTATED_ARG
+    from thunder_tpu.core.prims import OpTags
+    from thunder_tpu.distributed.prims import is_collective_bsym
+
+    if ctx is None:
+        ctx = VerifyContext(trace)
+    bsyms = ctx.bsyms
+    n = len(bsyms)
+    return_idx = next(
+        (i for i, b in enumerate(bsyms) if b.sym.id is PrimIDs.RETURN), n
+    )
+
+    # In-place writes, alias-rooted: (index, mutated buffer's root name).
+    root = alias_root_fn(bsyms)
+    inplace_writes: list = []
+    for m, b in enumerate(bsyms):
+        if not b.has_tag(OpTags.IN_PLACE):
+            continue
+        idx = INPLACE_MUTATED_ARG.get(b.sym.id, 0)
+        if idx < len(b.args) and hasattr(b.args[idx], "name"):
+            inplace_writes.append((m, root(b.args[idx].name)))
+
+    cert = ScheduleCertificate(
+        trace_name=trace.name, pass_name=ctx.pass_name
+    )
+    coll_idx = [i for i, b in enumerate(bsyms) if is_collective_bsym(b)]
+    by_axis: dict[str, list] = {}
+    for i in coll_idx:
+        axis = _collective_axis(bsyms[i])
+        if axis is not None:
+            by_axis.setdefault(axis, []).append(i)
+
+    for i in coll_idx:
+        bsym = bsyms[i]
+        axis = _collective_axis(bsym)
+        deps_before: set[int] = set()
+        deps_after: set[int] = set()
+
+        earliest = 0
+        for p in bsym.flat_proxy_args:
+            d = ctx.defs.get(p.name)
+            if d is not None and d[0] < i:
+                deps_before.add(d[0])
+                earliest = max(earliest, d[0] + 1)
+
+        latest = max(return_idx - 1, i)
+        pinned_out = False
+        for o in bsym.flat_proxy_outs:
+            name = getattr(o, "name", None)
+            if name is None:
+                continue
+            if name in ctx.output_names:
+                pinned_out = True
+            first_live = ctx.consumed_after(name, i)  # DELs excluded
+            if first_live is not None:
+                deps_after.add(first_live)
+                latest = min(latest, first_live - 1)
+        if pinned_out:
+            latest = min(latest, return_idx - 1)
+
+        # Anti-dependencies: an in-place write to an operand's buffer pins
+        # the site between the mutations it must read between.
+        if inplace_writes:
+            operand_roots = {
+                root(p.name) for p in bsym.flat_proxy_args
+                if hasattr(p, "name")
+            }
+            for m, w in inplace_writes:
+                if w not in operand_roots or m == i:
+                    continue
+                if m < i:
+                    deps_before.add(m)
+                    earliest = max(earliest, m + 1)
+                else:
+                    deps_after.add(m)
+                    latest = min(latest, m - 1)
+
+        peers = by_axis.get(axis, ()) if axis is not None else ()
+        if axis is not None:
+            pos = peers.index(i)
+            if pos > 0:
+                deps_before.add(peers[pos - 1])
+                earliest = max(earliest, peers[pos - 1] + 1)
+            if pos + 1 < len(peers):
+                deps_after.add(peers[pos + 1])
+                latest = min(latest, peers[pos + 1] - 1)
+
+        cert.sites.append(CollectiveSite(
+            index=i, sym=bsym.sym.name, axis=axis,
+            key=_site_key(i, bsym, axis), line=bsym.one_line(),
+            earliest=earliest, latest=max(latest, earliest),
+            deps_before=tuple(sorted(deps_before)),
+            deps_after=tuple(sorted(deps_after)),
+        ))
+
+    cert.axis_order = _axis_key_order(bsyms)
+    cert.fingerprint = hashlib.sha1(
+        repr(sorted(cert.axis_order.items())).encode()
+    ).hexdigest()
+    return cert
+
+
+def stamp(trace: TraceCtx, cert: Optional[ScheduleCertificate] = None) -> ScheduleCertificate:
+    """Record ``cert``'s per-axis order on the trace
+    (``tags["collective_order"]``) — the baseline the
+    ``sched.uncertified-reorder`` rule compares later passes against.
+    ``from_trace`` copies tags, so every downstream pass inherits it."""
+    if cert is None:
+        cert = certify(trace)
+    trace.tags["collective_order"] = dict(cert.axis_order)
+    return cert
+
+
+def recertify(trace: TraceCtx) -> ScheduleCertificate:
+    """What a pass that legally reorders collectives calls on its output:
+    re-derive the certificate and replace the stamped order, so the
+    verifier accepts the new schedule as the baseline going forward."""
+    return stamp(trace)
+
+
+def _bsym_index_of_key(bsyms, key: str) -> Optional[int]:
+    from thunder_tpu.distributed.prims import is_collective_bsym
+
+    for i, bsym in enumerate(bsyms):
+        if is_collective_bsym(bsym) and _site_key(i, bsym, _collective_axis(bsym)) == key:
+            return i
+    return None
+
+
+# =============================================================================
+# Verifier rule
+# =============================================================================
+
+
+@register_rule(
+    "sched.uncertified-reorder",
+    "Collectives keep their certified per-axis program order across passes",
+)
+def uncertified_reorder(ctx: VerifyContext) -> None:
+    """Compares the trace's per-axis collective order against the stamped
+    baseline. Additions (grad's reduce_scatters) and deletions (DCE) are
+    legal; an *inversion* of two surviving same-axis collectives is the
+    cross-host deadlock shape and is an ERROR attributed to the pass —
+    unless the pass re-certified (``schedule.recertify``) its output.
+    First sight of a trace with collectives stamps the baseline."""
+    current = _axis_key_order(ctx.bsyms)
+    tagged = ctx.trace.tags.get("collective_order")
+    if tagged is None:
+        if current:
+            ctx.trace.tags["collective_order"] = current
+        return
+    found_inversion = False
+    for axis, old in tagged.items():
+        new = current.get(axis, ())
+        pos = {k: p for p, k in enumerate(new)}
+        common = [k for k in old if k in pos]
+        positions = [pos[k] for k in common]
+        inversion = next(
+            (
+                (common[j], common[j + 1])
+                for j in range(len(common) - 1)
+                if positions[j] > positions[j + 1]
+            ),
+            None,
+        )
+        if inversion is not None:
+            found_inversion = True
+            first, second = inversion
+            ctx.report(
+                "sched.uncertified-reorder",
+                Severity.ERROR,
+                f"axis {axis!r}: collectives {first} and {second} swapped their "
+                "certified program order — hosts agreeing on the OLD order would "
+                "deadlock against hosts running this trace",
+                bsym_index=_bsym_index_of_key(ctx.bsyms, first),
+                hint="a pass moving collectives must prove the move via "
+                "analysis.schedule.certify (movable range) and re-stamp with "
+                "schedule.recertify(trace)",
+            )
+    # Refresh the baseline so the next pass diffs against THIS trace —
+    # but never adopt an order we just flagged: only schedule.recertify
+    # (a pass that PROVED its move) may bless a reorder, otherwise a
+    # re-verify of the same flagged trace would report clean.
+    if not found_inversion:
+        ctx.trace.tags["collective_order"] = current

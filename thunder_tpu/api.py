@@ -665,12 +665,44 @@ def _compile_entry_impl(
     # guard re-runs under a NaN watcher to attribute a non-finite step.
     claimed_extrace = extrace
 
+    # -- static planner suite (ISSUE 10) --------------------------------------
+    # Runs on every compile (O(trace), its seconds are a gated compile phase):
+    # stamps donation metadata on the claimed trace, predicts the per-device
+    # peak HBM live-set (consulted by the de-opt ladder on an OOM), and
+    # certifies the collective schedule (consumed by the watchdog's timeout
+    # diagnosis and the sched.* verifier rule).
+    _phase_mark = timer_ns()
+    on_nan_opt = cd.compile_options.get("on_nan")
+    # Resolved here (not at the staging block) because donation only happens
+    # when the entry actually stages under jax.jit: an unstaged entry
+    # (disable_jit_staging / device-sync ops / instrumentation) donates
+    # nothing, and the planner must price — and the donation.* rules must
+    # see — what will really run.
+    instrument_hooks = _resolve_instrument_hooks(cd)
+    device_sync = _has_tag_in_trace(extrace, OpTags.DEVICE_SYNC_OP)
+    will_stage = not (cd.disable_jit_staging or device_sync or instrument_hooks)
+    donate_buckets = (
+        will_stage
+        and sym_spec is not None
+        and deopt_level < 1
+        and on_nan_opt != "rerun-instrumented"
+        and jaxex._donation_active()
+    )
+    static_plan, static_cert = _static_planner(
+        extrace, sym_spec,
+        donate=donate_buckets,
+        rerun_capable=on_nan_opt == "rerun-instrumented",
+    )
+    phases["static_analysis"] = (timer_ns() - _phase_mark) / 1e9
+    _phase_mark = timer_ns()  # codegen span starts after the planner
+
     # Per-op instrumentation (observability/instrument.py): bracket every
     # value-producing bsym with host pre/post hooks. Runs after claiming (so
     # records carry the executor) and before del_last_used (so dels land
     # after the hooks that consume the values). Instrumented entries execute
-    # UNSTAGED — the hooks are host side effects XLA cannot stage.
-    instrument_hooks = _resolve_instrument_hooks(cd)
+    # UNSTAGED — the hooks are host side effects XLA cannot stage. (Hooks
+    # were resolved above, before the static planner, so the donation
+    # decision already knows this entry won't stage.)
     if instrument_hooks:
         from thunder_tpu.observability.instrument import instrument_for_execution
 
@@ -717,8 +749,7 @@ def _compile_entry_impl(
     _phase_mark = timer_ns()
 
     needs_rng = bool(extrace.tags.get(RNG_TAG))
-    device_sync = _has_tag_in_trace(extrace, OpTags.DEVICE_SYNC_OP)
-    if cd.disable_jit_staging or device_sync or instrument_hooks:
+    if not will_stage:
         computation_fn = trace_callable
     elif sym_spec is not None:
         # Bucketed staging: padded input buffers are dispatch-owned
@@ -726,11 +757,18 @@ def _compile_entry_impl(
         # the de-opt ladder disabled donation (level ≥ 1), or the on_nan
         # guard may re-run these exact buffers through the instrumented
         # trace (donated arrays are deleted after the staged run).
+        # donate_buckets is THE donation predicate, computed once above for
+        # the static planner — staging must not re-derive it (drift between
+        # what was planned and what the executor does).
         computation_fn = jaxex.stage_bucketed(
-            trace_callable, sorted(sym_spec.marks),
-            donate=deopt_level < 1
-            and cd.compile_options.get("on_nan") != "rerun-instrumented",
+            trace_callable, sorted(sym_spec.marks), donate=donate_buckets,
         )
+        # Reconcile the trace's donation metadata with what the executor
+        # actually stamped — by construction they agree (one predicate), but
+        # the donation.* rules must read the executor's truth, not a plan.
+        actual = getattr(computation_fn, "_thunder_donated_argnums", None)
+        if actual is not None and not actual and extrace.tags.get("donated_inputs"):
+            extrace.tags["donated_inputs"] = ()
     else:
         computation_fn = jax.jit(trace_callable)
     # jax.jit wrapper construction only — the XLA compile itself happens at
@@ -762,9 +800,19 @@ def _compile_entry_impl(
     entry.stats.degradation_level = deopt_level
     entry.stats.phases = phases
     entry.compile_id = compile_id
+    if static_plan is not None:
+        entry.stats.predicted_peak_bytes = int(static_plan.peak_bytes)
+    entry.schedule_certificate = static_cert
     cs.trace_seconds += entry.stats.trace_s
-    for phase in ("trace", "transforms", "claim", "codegen", "staging"):
-        _record_compile_phase(compile_id, phase, phases.get(phase, 0.0))
+    for phase in ("trace", "transforms", "claim", "static_analysis", "codegen",
+                  "staging"):
+        extra = {}
+        if phase == "static_analysis" and static_plan is not None:
+            extra = dict(
+                predicted_peak_bytes=int(static_plan.peak_bytes),
+                collective_sites=len(static_cert.sites) if static_cert else 0,
+            )
+        _record_compile_phase(compile_id, phase, phases.get(phase, 0.0), **extra)
 
     # Observability: compile-side metrics + the compile_end event carrying
     # the executor-claim breakdown and static collective traffic of the
@@ -799,6 +847,38 @@ def _compile_entry_impl(
     if cd.cache_option is not CACHE_OPTIONS.NO_CACHING:
         cs.cache_entries.append(entry)
     return entry
+
+
+def _static_planner(extrace: TraceCtx, sym_spec, *, donate: bool,
+                    rerun_capable: bool):
+    """The compile pipeline's static_analysis phase (ISSUE 10): stamp
+    donation metadata on the claimed execution trace, plan its HBM liveness,
+    and certify its collective schedule. Returns ``(MemoryPlan | None,
+    ScheduleCertificate | None)`` — planning failures degrade to None, never
+    break a compile."""
+    try:
+        from thunder_tpu.analysis import liveness as live_mod
+        from thunder_tpu.analysis import schedule as sched_mod
+
+        donated_names: tuple = ()
+        if donate and sym_spec is not None:
+            args = [a for a in extrace.args if isinstance(a, TensorProxy)]
+            donated_names = tuple(
+                args[li].name for li in sorted(sym_spec.marks) if li < len(args)
+            )
+        extrace.tags["donated_inputs"] = donated_names
+        if rerun_capable:
+            extrace.tags["rerun_reads_inputs"] = True
+        plan = live_mod.plan_liveness(
+            extrace, donated=donated_names, include_rows=False
+        )
+        # Certify + stamp the per-axis collective order baseline; the
+        # sched.uncertified-reorder rule diffs later passes against it, and
+        # the watchdog attaches the axis order to timeout diagnoses.
+        cert = sched_mod.stamp(extrace)
+        return plan, cert
+    except Exception:  # noqa: BLE001 — the planner is advisory, never fatal
+        return None, None
 
 
 def _resolve_instrument_hooks(cd: CompileData) -> tuple:
@@ -943,7 +1023,10 @@ def _run_entry(entry: CacheEntry, flat_inps: tuple, prepared=None) -> Any:
     if entry.sym_spec is not None:
         import numpy as np
 
-        # Runtime true extents feed the reduction masks (transforms/padmask.py).
+        # Runtime true extents feed the reduction masks (transforms/padmask.py)
+        # — and the de-opt ladder's L3 exact-shape peak prediction for THIS
+        # call, should this dispatch OOM (resilience/deopt.py).
+        entry.last_true_extents = true_extents
         inps = inps + [
             np.asarray(true_extents[cid], np.int32) for cid in entry.sym_spec.mask_classes
         ]
@@ -957,7 +1040,8 @@ def _run_entry(entry: CacheEntry, flat_inps: tuple, prepared=None) -> Any:
         chaos_mod.run_seam(
             has_collectives=bool(
                 trc is not None and int(trc.tags.get("collective_bytes") or 0)
-            )
+            ),
+            deopt_level=entry.stats.degradation_level,
         )
     if watchdog_mod.active_timeout() is not None:
         # Collective watchdog (ISSUE 9): a dispatch whose trace contains
@@ -971,10 +1055,12 @@ def _run_entry(entry: CacheEntry, flat_inps: tuple, prepared=None) -> Any:
             trc = entry.computation_traces[-1] if entry.computation_traces else None
             entry.collective_lines = tuple(dist_prims.collective_trace_lines(trc))
         if entry.collective_lines:
+            cert = entry.schedule_certificate
             out = watchdog_mod.guard_call(
                 entry.computation_fn, tuple(inps),
                 fn_name=getattr(entry.computation_fn, "__name__", "computation"),
                 trace_lines=entry.collective_lines,
+                schedule=cert.axis_labels() if cert is not None else None,
             )
         else:
             out = entry.computation_fn(*inps)

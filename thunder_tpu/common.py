@@ -157,7 +157,8 @@ class EntryStats:
     """Per-cache-entry counters (ISSUE 2: cache observability)."""
 
     __slots__ = ("hits", "fast_hits", "prologue_runs", "guard_fails", "trace_s",
-                 "first_run_s", "degradation_level", "phases")
+                 "first_run_s", "degradation_level", "phases",
+                 "predicted_peak_bytes")
 
     def __init__(self):
         self.hits = 0  # times this entry served a call
@@ -171,10 +172,15 @@ class EntryStats:
         # shapes. Surfaced per entry by thunder_tpu.cache_info.
         self.degradation_level = 0
         # Compile-phase spans (seconds) of this entry's build: trace /
-        # transforms / claim / staging / xla_compile, plus the persistent
-        # XLA cache verdict ("persistent_cache": "hit"|"miss") when jax's
-        # cache resolved the first run. Mirrors the compile_phase events.
+        # transforms / claim / static_analysis / staging / xla_compile, plus
+        # the persistent XLA cache verdict ("persistent_cache": "hit"|"miss")
+        # when jax's cache resolved the first run. Mirrors the compile_phase
+        # events.
         self.phases: dict = {}
+        # Static liveness planner's predicted per-device peak HBM for this
+        # entry (analysis/liveness.py; None when planning failed or was
+        # skipped) — what the de-opt ladder consults to jump levels.
+        self.predicted_peak_bytes = None
 
     def as_dict(self) -> dict:
         return {k: getattr(self, k) for k in self.__slots__}
@@ -220,6 +226,13 @@ class CacheEntry:
     # the collective watchdog names in a CollectiveTimeoutError and the
     # gate deciding whether a dispatch is guarded at all (api._run_entry).
     collective_lines: Any = None
+    # Static planner artifacts (ISSUE 10; api._compile_entry_impl's
+    # static_analysis phase): the schedule certificate the watchdog's
+    # timeout diagnosis consumes, and the last call's true bucket extents
+    # (set per dispatch) so the de-opt ladder can price the L3 exact-shape
+    # level for the failing call.
+    schedule_certificate: Any = None
+    last_true_extents: Any = None
     stats: EntryStats = field(default_factory=EntryStats)
 
 

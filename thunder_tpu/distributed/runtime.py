@@ -17,7 +17,7 @@ from typing import Any, Callable, Optional, Sequence
 
 
 def shard_map_callable(fn: Callable, mesh, in_specs, out_specs, *, check_rep: bool = False,
-                       trace_lines=None) -> Callable:
+                       trace_lines=None, schedule=None) -> Callable:
     """Wrap a pure callable in shard_map over ``mesh`` and jit it.
 
     The result routes through the collective watchdog
@@ -41,6 +41,7 @@ def shard_map_callable(fn: Callable, mesh, in_specs, out_specs, *, check_rep: bo
         jax.jit(inner),
         fn_name=getattr(fn, "__name__", "shard_map"),
         trace_lines=trace_lines,
+        schedule=schedule,
     )
 
 
@@ -72,8 +73,20 @@ def compile_with_collectives(
         comp = grad_transform(comp, return_value=True)
     extrace = transform_for_execution(comp, resolve_executors(None))
     inner = extrace.python_callable()
+    # Certify the collective schedule (ISSUE 10): stamps the per-axis order
+    # baseline on the trace and hands the watchdog the certified order so a
+    # timeout names the collectives that must already have completed before
+    # the pending one. Advisory — certification failure never blocks staging.
+    schedule = None
+    try:
+        from thunder_tpu.analysis import schedule as sched_mod
+
+        schedule = sched_mod.stamp(extrace).axis_labels()
+    except Exception:  # noqa: BLE001
+        pass
     jf = shard_map_callable(
         inner, mesh, in_specs, out_specs,
         trace_lines=collective_trace_lines(extrace),
+        schedule=schedule,
     )
     return jf, extrace

@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 from thunder_tpu.analysis.cost import cost_report, trace_cost  # noqa: F401  (examine.cost_report)
+from thunder_tpu.analysis.liveness import memory_report, plan_liveness  # noqa: F401  (examine.memory_report)
 from thunder_tpu.core.prims import OpTags, PrimIDs
 from thunder_tpu.core.proxies import TensorProxy, variableify
 from thunder_tpu.core.pytree import tree_flatten

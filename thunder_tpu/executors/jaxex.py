@@ -571,10 +571,26 @@ def stage_bucketed(trace_callable, donate_leaves: Sequence[int], *, donate: bool
     """jax.jit a trace callable whose ``donate_leaves`` argument positions
     receive freshly padded (dispatch-owned) buffers. Donation is skipped on
     CPU, where jax does not implement it (and would warn per call), and at
-    de-opt ladder level ≥ 1 (``donate=False`` — resilience/deopt.py)."""
-    if donate and _donation_active() and donate_leaves:
-        return jax.jit(trace_callable, donate_argnums=tuple(donate_leaves))
-    return jax.jit(trace_callable)
+    de-opt ladder level ≥ 1 (``donate=False`` — resilience/deopt.py).
+
+    The actual donation decision is stamped on the staged callable
+    (``_thunder_donated_argnums``): api._compile_entry_impl reconciles the
+    claimed trace's ``donated_inputs`` tag against it after staging, and
+    it is the introspection point for anyone holding only the jitted
+    callable. The caller's ``donate`` must already be the full predicate
+    (api's ``donate_buckets``); this function only adds the backend checks
+    it owns (CPU has no donation)."""
+    donating = bool(donate and _donation_active() and donate_leaves)
+    jfn = (
+        jax.jit(trace_callable, donate_argnums=tuple(donate_leaves))
+        if donating
+        else jax.jit(trace_callable)
+    )
+    try:
+        jfn._thunder_donated_argnums = tuple(donate_leaves) if donating else ()
+    except Exception:  # jit wrapper without attribute support
+        pass
+    return jfn
 
 
 def pad_to_bucket(inps: list, sym_spec) -> list:

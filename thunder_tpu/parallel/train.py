@@ -157,8 +157,34 @@ def build_train_step(
 
     opt_state = adamw_init(params) if optimizer != "sgd" else {"step": 0}
 
+    # Donation metadata for the static planner suite (ISSUE 10): the param
+    # leaves of the claimed trace are the donated buffers, so the liveness
+    # planner frees them at last use, and the donation sanitizer rules
+    # (analysis/rules.py donation.*) can check the SDC/rerun invariants
+    # statically. donate_argnums=(0, 1) ALSO donates the optimizer state,
+    # but the opt update is staged in the outer `step` jit, OUTSIDE the
+    # claimed trace — opt leaves have no trace-level proxies to tag, so the
+    # trace metadata covers exactly the donated buffers the trace can see
+    # (params); the step-level invariant (SDC re-run needs the whole
+    # previous state alive) is carried by _thunder_donates on the callable,
+    # which run_training checks up front.
+    if donate:
+        from thunder_tpu.core.proxies import TensorProxy
+
+        n_params = len(tree_flatten(params)[0])
+        extrace.tags["donated_inputs"] = tuple(
+            a.name for a in extrace.args[:n_params] if isinstance(a, TensorProxy)
+        )
+
+    def _stamp(jfn):
+        try:
+            jfn._thunder_donates = bool(donate)
+        except Exception:  # jit wrapper without attribute support
+            pass
+        return jfn
+
     if mesh is None:
-        jfn = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+        jfn = _stamp(jax.jit(step, donate_argnums=(0, 1) if donate else ()))
         return (jfn, opt_state, extrace) if return_extrace else (jfn, opt_state)
 
     from thunder_tpu.parallel.sharding import data_spec as _dspec
@@ -175,10 +201,10 @@ def build_train_step(
     data_sh = NamedSharding(mesh, batch_spec)
     loss_sh = NamedSharding(mesh, PartitionSpec())
 
-    jfn = jax.jit(
+    jfn = _stamp(jax.jit(
         step,
         in_shardings=(param_sh, opt_sh, data_sh, data_sh),
         out_shardings=(param_sh, opt_sh, loss_sh),
         donate_argnums=(0, 1) if donate else (),
-    )
+    ))
     return (jfn, opt_state, extrace) if return_extrace else (jfn, opt_state)

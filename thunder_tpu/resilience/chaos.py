@@ -54,7 +54,10 @@ Spec grammar (``THUNDER_TPU_CHAOS=<spec>`` or ``jit(chaos=<spec>)``)::
 ``target`` is seam-specific: for ``kernel_raise`` an executor name or
 ``executor:op`` substring; for ``nan`` a BoundSymbol-name substring or
 ``L<index>``; for ``preempt``/``host_loss`` the step number; for ``sdc``
-the replica ordinal to corrupt. A ``host=N`` clause restricts any seam to
+the replica ordinal to corrupt; for ``oom`` an optional ``<LEVEL`` clause
+(``oom@<3*inf``) that keeps firing while the entry's de-opt ladder level
+is below LEVEL — a deterministic memory ceiling for exercising the
+planner-guided ladder (resilience/deopt.py). A ``host=N`` clause restricts any seam to
 the process with ``jax.process_index() == N`` (multi-host targeting; the
 ``THUNDER_TPU_CHAOS_PROCESS_INDEX`` env var overrides the index for
 single-process simulation and tests). Examples::
@@ -355,12 +358,19 @@ def resolve(config) -> Optional[ChaosConfig]:
 # -- injection core ------------------------------------------------------------
 
 
-def _should_fire(seam: str, target: Optional[str] = None) -> Optional[FaultRule]:
+def _should_fire(seam: str, target: Optional[str] = None,
+                 matcher=None) -> Optional[FaultRule]:
+    """One copy of the fire-decision protocol (exhausted → match → host →
+    prob draw → fired/record). ``matcher(rule) -> bool`` replaces the
+    default substring ``rule.matches(target)`` for seams whose target
+    grammar is not a substring (the oom ``<LEVEL`` ceiling)."""
     cfg = active()
     if cfg is None:
         return None
     for rule in cfg.rules_for(seam):
-        if rule.exhausted() or not rule.matches(target) or not rule.host_matches():
+        if rule.exhausted() or not rule.host_matches():
+            continue
+        if not (matcher(rule) if matcher is not None else rule.matches(target)):
             continue
         if rule.prob < 1.0 and cfg.rng.random() >= rule.prob:
             continue
@@ -405,13 +415,32 @@ def compile_seam(fn_name: str) -> None:
         raise InjectedCompileError(fn_name)
 
 
-def run_seam(has_collectives: bool = False) -> None:
+def run_seam(has_collectives: bool = False, deopt_level: int = 0) -> None:
     """Dispatch-time seam (api._run_entry): device OOM, and the collective
     straggler delay (fires on any entry when the rule's target is ``any``,
-    else only on traces containing collectives)."""
+    else only on traces containing collectives).
+
+    The ``oom`` seam's target grammar: ``oom`` (fire per its count, as
+    before) or ``oom@<L`` — keep firing while the dispatched entry's de-opt
+    ladder level is **below** L. The latter is a deterministic memory
+    ceiling: exactly what a chip whose HBM only fits ladder level L looks
+    like, which is how ``lint_traces.py --static`` proves the planner jump
+    pays fewer failed compiles than blind climbing."""
     if active() is None:
         return
-    if _should_fire("oom") is not None:
+
+    def _oom_matches(rule: FaultRule) -> bool:
+        t = rule.target
+        if not t:
+            return True
+        if not t.startswith("<"):
+            return False  # oom has no other target form
+        try:
+            return deopt_level < int(t[1:])
+        except ValueError:
+            return False
+
+    if _should_fire("oom", f"level{deopt_level}", matcher=_oom_matches) is not None:
         raise InjectedOOMError()
     cfg = active()
     for rule in cfg.rules_for("straggler"):

@@ -19,6 +19,17 @@ that OOMs at L0 compiles at L1 from then on; the TTL story for climbing
 back up is future work) and each entry records the level it was compiled
 at — surfaced as ``degradation_level`` in ``thunder_tpu.cache_info``.
 
+On an **OOM**-shaped failure the ladder no longer climbs blind: the static
+liveness planner (``analysis/liveness.py``, ISSUE 10) prices the peak HBM
+live-set of each remaining level from the failing entry's claimed trace —
+donation off at L1+, the failing call's exact extents at L3 — and the
+ladder jumps straight to the first level predicted to fit the device
+capacity, skipping levels *proven* still too big (the prediction is a
+lower bound, so predicted ≥ capacity is a proof). Every jump logs
+``predicted_peak_bytes``/``capacity_bytes``/``skipped_levels`` in its
+``compile_deopt`` event. Capacity: ``THUNDER_TPU_HBM_BYTES`` override →
+backend ``memory_stats()['bytes_limit']`` → the DeviceSpec datasheet.
+
 Also here: the cheap post-step isfinite guard (``jit(on_nan=...)``) —
 on a non-finite output the failing step is re-run once **instrumented**
 under a NaN watcher so the producing op is attributed before raising
@@ -67,16 +78,110 @@ def current_level(cd) -> int:
     return getattr(cd, "_deopt_level", 0)
 
 
-def escalate(cd, reason: str, attempt: int) -> bool:
-    """Bump ``cd``'s ladder position (bounded), record it, and sleep the
-    backoff. False when the ladder is exhausted — the caller re-raises."""
-    level = current_level(cd) + 1
+def _planned_peaks(entry, cs, cd=None):
+    """(predicted per-level peak bytes, device capacity bytes) for the
+    failing entry's claimed trace — the static liveness planner's input to
+    level selection (analysis/liveness.py). (None, None) when no trace or
+    capacity is known (the ladder then climbs blind, exactly as before)."""
+    from thunder_tpu.common import CACHE_OPTIONS
+
+    trace = None
+    sym_spec = None
+    true_extents = None
+    if entry is not None:
+        sym_spec = entry.sym_spec
+        true_extents = getattr(entry, "last_true_extents", None)
+        if entry.computation_traces:
+            trace = entry.computation_traces[-1]
+    if trace is None and cs is not None and getattr(cs, "last_traces", None):
+        trace = cs.last_traces[-1]
+    if trace is None:
+        return None, None
+    from thunder_tpu.analysis.liveness import (
+        device_capacity_bytes,
+        predict_level_peaks,
+    )
+
+    capacity = device_capacity_bytes()
+    if not capacity:
+        return None, None
+    # Without an entry in hand (a failure during the build itself) we may
+    # hold a stale trace of a symbolic-cache function whose sym_spec we
+    # cannot see — L3 must stay unprovable rather than inherit L1's peak.
+    bucketing_unknown = (
+        entry is None
+        and getattr(cd, "cache_option", None) is CACHE_OPTIONS.SYMBOLIC_VALUES
+    )
+    peaks = predict_level_peaks(
+        trace,
+        sym_spec=sym_spec,
+        donated=trace.tags.get("donated_inputs") or (),
+        true_extents=true_extents,
+        bucketing_unknown=bucketing_unknown,
+    )
+    return peaks, capacity
+
+
+def _choose_level(peaks: dict, capacity: int, base: int):
+    """First ladder level above ``base`` whose predicted peak fits the
+    capacity, skipping levels the planner *proves* still won't fit (the
+    prediction is a lower bound: predicted >= capacity ⇒ the real run is
+    certainly bigger). Unknown peaks (None) are never skipped. When no
+    level fits, fall back to the blind single-step climb — the planner is
+    advisory, the ladder still terminates the same way."""
+    skipped: list[int] = []
+    for level in range(base + 1, MAX_LEVEL + 1):
+        p = peaks.get(level)
+        if p is None or p < capacity:
+            return level, p, skipped
+        skipped.append(level)
+    # Nothing fits: blind one-step climb. No prediction attached — the
+    # resulting compile_deopt must not look planner-guided (consumers
+    # detect guidance by field presence).
+    return base + 1, None, []
+
+
+def escalate(cd, reason: str, attempt: int, *, entry=None, cs=None) -> bool:
+    """Bump ``cd``'s ladder position, record it, and sleep the backoff.
+    False when the ladder is exhausted — the caller re-raises.
+
+    With an OOM-shaped failure the static liveness planner
+    (:func:`_planned_peaks`) prices each remaining level and the ladder
+    jumps straight to the first one predicted to fit, instead of paying one
+    failed ~20s XLA compile per level to discover the same thing; levels
+    skipped this way are named in the ``compile_deopt`` event
+    (``skipped_levels``), alongside ``predicted_peak_bytes``/
+    ``capacity_bytes``."""
+    base = current_level(cd)
+    level = base + 1
+    predicted = None
+    capacity = None
+    skipped: list[int] = []
+    if level <= MAX_LEVEL and "oom" in reason:
+        try:
+            peaks, capacity = _planned_peaks(entry, cs, cd)
+        except Exception:  # noqa: BLE001 — planning must never block recovery
+            peaks = None
+        if peaks and capacity:
+            level, predicted, skipped = _choose_level(peaks, capacity, base)
     if level > MAX_LEVEL or attempt >= max_attempts():
         return False
     cd._deopt_level = level
     backoff = _backoff_s(attempt)
     if obsm.enabled():
         obsm.COMPILE_DEOPTS.inc(level=str(level))
+    # Planner fields appear ONLY on planner-guided escalations (a level was
+    # priced or proven-skipped) — consumers detect guidance by field
+    # presence, so blind climbs must not emit nulls or a lone capacity.
+    planner = {}
+    if predicted is not None or skipped:
+        planner = {
+            k: v
+            for k, v in (("predicted_peak_bytes", predicted),
+                         ("capacity_bytes", capacity),
+                         ("skipped_levels", skipped or None))
+            if v is not None
+        }
     obs_events.emit_event(
         "compile_deopt",
         level=level,
@@ -84,6 +189,7 @@ def escalate(cd, reason: str, attempt: int) -> bool:
         reason=reason,
         attempt=attempt,
         backoff_s=backoff,
+        **planner,
     )
     if backoff:
         time.sleep(backoff)
@@ -98,7 +204,7 @@ def handle_compile_failure(exc: BaseException, cd, cs, attempt: int) -> bool:
     (tracing/claiming/staging). True → the caller retries the compile."""
     kind = demotion.classify_failure(exc)
     if kind in (demotion.COMPILE, demotion.OOM):
-        return escalate(cd, f"compile failure: {kind}", attempt)
+        return escalate(cd, f"compile failure: {kind}", attempt, cs=cs)
     if kind == demotion.KERNEL:
         # A kernel executor raised while staging its claimed op: demote and
         # re-claim (no ladder bump needed — the program itself is fine).
@@ -120,7 +226,7 @@ def handle_run_failure(exc: BaseException, cd, cs, entry, attempt: int) -> bool:
         extrace = entry.computation_traces[-1] if entry.computation_traces else None
         return _demote_from(exc, extrace, cs, attempt)
     if kind in (demotion.COMPILE, demotion.OOM):
-        return escalate(cd, f"run failure: {kind}", attempt)
+        return escalate(cd, f"run failure: {kind}", attempt, entry=entry, cs=cs)
     if kind == demotion.CACHE_CORRUPT:
         return _purge_compile_cache(exc, attempt)
     return False

@@ -458,6 +458,19 @@ def run_training(
     from thunder_tpu.resilience import watchdog as wd
 
     sdc = wd.resolve_sdc_guard(sdc_guard)
+    # The PR 9 invariant, checked statically instead of by convention
+    # (ISSUE 10 donation sanitizer): the SDC re-run replays the PREVIOUS
+    # state through step_fn, so a donating step would hand XLA buffers the
+    # re-run still needs. build_train_step stamps its donation decision on
+    # the callable; reject the combination up front rather than corrupting
+    # the re-run.
+    if sdc is not None and getattr(step_fn, "_thunder_donates", False):
+        raise ValueError(
+            "run_training(sdc_guard=...) requires a non-donating step_fn: the "
+            "quarantine re-run reads the previous state after the step ran, "
+            "but this step donates its input buffers to XLA "
+            "(build_train_step(donate=False))"
+        )
     step_name = getattr(step_fn, "__name__", "step")
     own_guard = guard is None
     guard = guard if guard is not None else PreemptionGuard().install()

@@ -57,11 +57,18 @@ class CollectiveTimeoutError(RuntimeError):
 
     def __init__(self, fn_name: str, timeout_s: float,
                  trace_lines: Optional[Sequence[str]] = None,
-                 suspected_host: Optional[Any] = None):
+                 suspected_host: Optional[Any] = None,
+                 schedule: Optional[dict] = None):
         self.fn_name = fn_name
         self.timeout_s = timeout_s
         self.trace_lines = list(trace_lines or [])
         self.suspected_host = suspected_host
+        # Certified per-axis collective order of the guarded program
+        # ({axis: ["L<i>.<sym>", ...]} — analysis/schedule.ScheduleCertificate
+        # .axis_labels()): everything left of a pending collective must have
+        # completed on every healthy host, which is what narrows a hang to
+        # the first line a dead peer never reached.
+        self.schedule = dict(schedule or {})
         lines = ", ".join(self.trace_lines) if self.trace_lines else \
             "collectives inserted by the SPMD partitioner (no trace lines)"
         suspect = (
@@ -69,10 +76,16 @@ class CollectiveTimeoutError(RuntimeError):
             if suspected_host is not None
             else "no straggler data (run monitor.host_health over per-host logs)"
         )
+        sched = ""
+        if self.schedule:
+            sched = "; certified order " + "; ".join(
+                f"{axis}: " + " -> ".join(labels)
+                for axis, labels in sorted(self.schedule.items())
+            )
         super().__init__(
             f"collective watchdog: {fn_name!r} exceeded {timeout_s:g}s — "
             f"a peer stopped participating; pending collectives: {lines}; "
-            f"{suspect}"
+            f"{suspect}{sched}"
         )
 
 
@@ -146,6 +159,7 @@ def guard_call(
     fn_name: str = "?",
     trace_lines: Optional[Sequence[str]] = None,
     timeout_s: Optional[float] = None,
+    schedule: Optional[dict] = None,
 ):
     """Run ``fn(*args, **kwargs)`` under the collective watchdog.
 
@@ -190,11 +204,14 @@ def guard_call(
         suspect = _suspected_host()
         if obsm.enabled():
             obsm.WATCHDOG_TIMEOUTS.inc(fn=fn_name)
+        # schedule appears only when the trace carried a certificate —
+        # consumers detect certification by field presence, not null.
+        extra = {"schedule": dict(schedule)} if schedule else {}
         obs_events.emit_event(
             "collective_timeout", fn=fn_name, timeout_s=timeout,
-            lines=lines, suspected_host=suspect,
+            lines=lines, suspected_host=suspect, **extra,
         )
-        raise CollectiveTimeoutError(fn_name, timeout, lines, suspect)
+        raise CollectiveTimeoutError(fn_name, timeout, lines, suspect, schedule)
     if "exc" in box:
         raise box["exc"]
     return box.get("out")
@@ -208,17 +225,20 @@ class _GuardedCallable:
     consumers probing ``hasattr(jfn, "lower")`` don't silently degrade."""
 
     def __init__(self, fn: Callable, name: str,
-                 trace_lines: Optional[Sequence[str]]):
+                 trace_lines: Optional[Sequence[str]],
+                 schedule: Optional[dict] = None):
         self.__wrapped__ = fn
         self._name = name
         self._trace_lines = trace_lines
+        self._schedule = schedule
         self.__name__ = f"watchdog[{name}]"
 
     def __call__(self, *args, **kwargs):
         if active_timeout() is None:
             return self.__wrapped__(*args, **kwargs)
         return guard_call(self.__wrapped__, args, kwargs, fn_name=self._name,
-                          trace_lines=self._trace_lines)
+                          trace_lines=self._trace_lines,
+                          schedule=self._schedule)
 
     def __getattr__(self, item):
         return getattr(self.__wrapped__, item)
@@ -228,14 +248,17 @@ class _GuardedCallable:
 
 
 def wrap(fn: Callable, *, fn_name: Optional[str] = None,
-         trace_lines: Optional[Sequence[str]] = None) -> Callable:
+         trace_lines: Optional[Sequence[str]] = None,
+         schedule: Optional[dict] = None) -> Callable:
     """A callable that routes through :func:`guard_call` when the watchdog
     is armed at call time and is a plain passthrough otherwise — dispatch
     sites wrap once at build time and pay one probe per call. Non-call
     attribute access (``lower``, ``as_text``, ...) passes through to
-    ``fn``."""
+    ``fn``. ``schedule`` is the certified per-axis collective order
+    (``analysis.schedule.ScheduleCertificate.axis_labels()``) attached to
+    any timeout diagnosis."""
     return _GuardedCallable(fn, fn_name or getattr(fn, "__name__", "?"),
-                            trace_lines)
+                            trace_lines, schedule)
 
 
 # =============================================================================
