@@ -38,6 +38,16 @@ Usage:
         # failure, OOM, NaN poison) and fail on any unrecovered fault,
         # non-baseline-equal recovery, or missing degradation event in the
         # JSONL log (replayed through the correlation rule)
+    python scripts/lint_traces.py --soak
+        # fleet-autopilot soak smoke (ISSUE 11; docs/robustness.md "fleet
+        # autopilot"): a short deterministic (seeded) scripts/soak_fleet.py
+        # run on the 8-device virtual mesh — must end with zero unrecovered
+        # faults and zero unactuated autopilot decisions, exercise at least
+        # one decision of every policy class (elastic_resume,
+        # quarantine_rerun, deopt_escalate, checkpoint_halt), and land a
+        # per-fault recovery cost within the soak noise floor of the
+        # committed SOAK_r*.json round; full runs gate the committed
+        # series via perf_report --gate
     python scripts/lint_traces.py --chaos-multihost
         # mesh-wide resilience smoke (ISSUE 9): the FSDP×TP training step
         # on a virtual 8-device mesh under a canned host-loss +
@@ -605,6 +615,123 @@ def _chaos_smoke() -> int:
     return n_errors
 
 
+_SOAK_REQUIRED_KEYS = (
+    "metric", "value", "unit", "seed", "n_devices", "mesh", "model", "steps",
+    "soak_goodput_tokens_per_sec", "soak_tokens_per_sec",
+    "soak_ideal_tokens_per_sec", "soak_goodput_ratio",
+    "resilience_overhead_pct", "soak_wall_s", "soak_recovery_per_fault_s",
+    "soak_faults_injected",
+    "soak_fault_seams", "soak_overlapping_pairs", "soak_decisions",
+    "soak_unrecovered", "soak_unactuated",
+)
+
+# The four autopilot policy classes the smoke must see decided at least
+# once (the schedule's REQUIRED_SEAMS guarantee the triggering faults).
+_SOAK_POLICY_CLASSES = (
+    "elastic_resume", "quarantine_rerun", "deopt_escalate", "checkpoint_halt",
+)
+
+
+def _soak_smoke() -> int:
+    """--soak: the fleet-autopilot soak smoke (ISSUE 11 satellite). Runs a
+    short deterministic ``scripts/soak_fleet.py --smoke`` on the 8-device
+    virtual mesh and asserts: zero unrecovered faults, zero unactuated
+    decisions, at least one decision of EVERY policy class, every required
+    seam kind injected, and a per-fault recovery cost within the soak
+    noise floor of the committed ``SOAK_r*.json`` round. Full runs
+    additionally gate the committed series with ``perf_report --gate``.
+    Returns the error count."""
+    import glob
+    import json
+    import subprocess
+    import tempfile
+
+    scripts_dir = os.path.dirname(os.path.abspath(__file__))
+    repo_root = os.path.dirname(scripts_dir)
+    out_path = os.path.join(tempfile.mkdtemp(prefix="ttpu_soak_smoke_"), "soak.json")
+    cmd = [sys.executable, os.path.join(scripts_dir, "soak_fleet.py"),
+           "--smoke", "--seed", "7", "--out", out_path]
+    print("--- soak smoke: " + " ".join(cmd))
+    n_errors = 0
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=1500)
+    for line in r.stderr.strip().splitlines()[-20:]:
+        print(f"    {line}")
+    if r.returncode != 0:
+        print(f"    FAILED: soak_fleet exited {r.returncode}")
+        return 1
+    with open(out_path) as f:
+        result = json.load(f)
+
+    missing = [k for k in _SOAK_REQUIRED_KEYS if k not in result]
+    if missing:
+        n_errors += 1
+        print(f"    FAILED: soak JSON missing keys: {missing}")
+    else:
+        print(f"    schema OK ({len(_SOAK_REQUIRED_KEYS)} required keys)")
+
+    if result.get("soak_unrecovered") or result.get("soak_unactuated"):
+        n_errors += 1
+        print(f"    FAILED: unrecovered={result.get('soak_unrecovered')} "
+              f"unactuated={result.get('soak_unactuated')}")
+    else:
+        print("    correlation OK: zero unrecovered faults, zero unactuated "
+              "decisions")
+
+    decisions = result.get("soak_decisions") or {}
+    absent = [c for c in _SOAK_POLICY_CLASSES if not decisions.get(c)]
+    if absent:
+        n_errors += 1
+        print(f"    FAILED: policy classes never decided: {absent} "
+              f"(got {decisions})")
+    else:
+        print("    policy coverage OK: " + ", ".join(
+            f"{c}×{decisions[c]}" for c in _SOAK_POLICY_CLASSES))
+
+    seams = result.get("soak_fault_seams") or {}
+    if len(seams) < 5 or not result.get("soak_overlapping_pairs"):
+        n_errors += 1
+        print(f"    FAILED: schedule diversity (seams={sorted(seams)}, "
+              f"overlaps={result.get('soak_overlapping_pairs')})")
+    else:
+        print(f"    schedule OK: {result.get('soak_faults_injected')} faults "
+              f"across {len(seams)} seam kinds, "
+              f"{result['soak_overlapping_pairs']} overlapping pair(s)")
+
+    # Goodput sanity vs the committed round. The goodput RATIO swings with
+    # the machine's ideal step time (the CPU mesh cannot hold it steady
+    # run to run), so the portable comparator is the recovery cost charged
+    # per fault — wall time beyond ideal-speed useful steps, per injection
+    # — bounded by the soak noise floor (perf_report._SOAK_NOISE_FLOORS),
+    # doubled for the smoke's shorter run (one-off rebuild costs amortize
+    # over fewer faults).
+    committed = sorted(glob.glob(os.path.join(repo_root, "SOAK_r*.json")))
+    goodput = result.get("soak_goodput_tokens_per_sec")
+    per_fault = result.get("soak_recovery_per_fault_s")
+    if not isinstance(goodput, (int, float)) or goodput <= 0:
+        n_errors += 1
+        print(f"    FAILED: no usable goodput ({goodput})")
+    elif committed and isinstance(per_fault, (int, float)):
+        if scripts_dir not in sys.path:
+            sys.path.insert(0, scripts_dir)
+        from perf_report import noise_floor
+
+        with open(committed[-1]) as f:
+            ref = json.load(f).get("soak_recovery_per_fault_s")
+        floor = 2 * noise_floor("per_fault_s", "soak_goodput")
+        if isinstance(ref, (int, float)) and abs(per_fault - ref) > floor:
+            n_errors += 1
+            print(f"    FAILED: recovery cost {per_fault:.2f}s/fault vs "
+                  f"committed {ref:.2f} (floor ±{floor:.1f}s)")
+        else:
+            print(f"    goodput OK: {goodput:.0f} tok/s; recovery "
+                  f"{per_fault:.2f}s/fault (committed {ref}, floor "
+                  f"±{floor:.1f}s)")
+
+    n_errors += _bench_history_gate("SOAK_r*.json")
+    print(f"\nlint_traces --soak: {n_errors} error(s)")
+    return n_errors
+
+
 def _chaos_multihost_smoke() -> int:
     """--chaos-multihost: re-exec this script on a virtual 8-device CPU mesh
     (the device-count flag must be set before jax initializes) and run
@@ -808,8 +935,8 @@ def _chaos_multihost_inner() -> int:
 
 
 _USAGE = ("usage: lint_traces.py [pattern] | --static | --chaos | "
-          "--chaos-multihost | --multichip | --events <log.jsonl> [...] "
-          "[--storm-threshold N]")
+          "--chaos-multihost | --multichip | --soak | "
+          "--events <log.jsonl> [...] [--storm-threshold N]")
 
 
 def main(argv=None) -> int:
@@ -824,6 +951,9 @@ def main(argv=None) -> int:
     if "--static" in argv:
         print("--- static smoke: liveness prediction vs instrument='memory'")
         return 1 if _static_smoke() else 0
+
+    if "--soak" in argv:
+        return 1 if _soak_smoke() else 0
 
     if "--chaos" in argv:
         return 1 if _chaos_smoke() else 0
@@ -894,6 +1024,7 @@ def main(argv=None) -> int:
     if not pattern:
         n_errors += _bench_history_gate()
         n_errors += _bench_history_gate("MULTICHIP_BENCH_r*.json")
+        n_errors += _bench_history_gate("SOAK_r*.json")
 
     print(f"\nlint_traces: {n_errors} error(s), {n_warnings} warning(s)")
     return 1 if n_errors else 0

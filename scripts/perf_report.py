@@ -10,11 +10,14 @@ bench rounds and flag per-metric deltas beyond thresholds::
     python scripts/perf_report.py --history BENCH_r0*.json --gate   # CI: exit 1
                                                                     # on un-acked regressions
     python scripts/perf_report.py --history MULTICHIP_BENCH_r*.json --gate
+    python scripts/perf_report.py --history SOAK_r*.json --gate
 
-The single-host (``BENCH_r*.json``, from ``bench.py``) and multichip
-(``MULTICHIP_BENCH_r*.json``, from ``scripts/bench_multichip.py``) series
-are gated separately — one invocation per glob — with the same
-direction-aware deltas, noise floors, and ack semantics.
+The single-host (``BENCH_r*.json``, from ``bench.py``), multichip
+(``MULTICHIP_BENCH_r*.json``, from ``scripts/bench_multichip.py``), and
+soak (``SOAK_r*.json``, from ``scripts/soak_fleet.py`` — headline
+``value`` is goodput tokens/sec, gated UP-good) series are gated
+separately — one invocation per glob — with the same direction-aware
+deltas, noise floors, and ack semantics.
 
 Metric direction is inferred from the name (times/counts: lower is better;
 MFU/throughput/ratios-vs-baseline: higher is better); sub-noise-floor
@@ -56,7 +59,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # name like train_synced_mfu_vs_ref_mfu must not fall through to the "_s"
 # time suffix), then lower-is-better time/count shapes. Unmatched metrics are
 # reported in the trajectory but never gated.
-_HIGHER_SUBSTRINGS = ("mfu", "vs_baseline", "tokens_per_sec", "dots_passed")
+_HIGHER_SUBSTRINGS = ("mfu", "vs_baseline", "tokens_per_sec", "dots_passed",
+                      "goodput")
 _LOWER_SUFFIXES = ("_s", "_us", "_ms", "_pct", "_seconds")
 _LOWER_EXACT = {"value", "recompile_count"}
 
@@ -90,10 +94,31 @@ _MULTICHIP_NOISE_FLOORS = (
     ("overhead_pct", 5.0),
 )
 
+# SOAK_r* rounds (headline metric "soak_goodput"): goodput on the emulated
+# CPU mesh inherits the tiny-step jitter TWICE (ideal step AND soak wall
+# clock share the scheduler), and the recovery path lengths vary with
+# host load — the floors are sized to that, per the committed r01 noise
+# measurement, without touching the bench series.
+_SOAK_NOISE_FLOORS = (
+    ("value", 800.0),              # goodput tokens/s
+    ("tokens_per_sec", 800.0),
+    ("goodput_ratio", 0.15),
+    ("overhead_pct", 5.0),
+    ("per_fault_s", 2.5),          # recovery seconds charged per fault
+    ("wall_s", 60.0),
+    ("_s", 60.0),                  # any other second-scale soak timing
+)
 
-def metric_direction(name: str) -> Optional[int]:
-    """+1 = higher is better, -1 = lower is better, None = not gated."""
+
+def metric_direction(name: str, series: str = "") -> Optional[int]:
+    """+1 = higher is better, -1 = lower is better, None = not gated.
+    ``series`` (the round's headline ``metric`` name) resolves the fields
+    whose direction follows the series: the SOAK rounds' headline ``value``
+    is goodput tokens/sec (up-good), where every other series' ``value`` is
+    a time (down-good)."""
     low = name.lower()
+    if series.lower().startswith("soak") and low == "value":
+        return 1
     if any(s in low for s in _HIGHER_SUBSTRINGS):
         return 1
     if low in _LOWER_EXACT or low.endswith(_LOWER_SUFFIXES):
@@ -103,11 +128,15 @@ def metric_direction(name: str) -> Optional[int]:
 
 def noise_floor(name: str, series: str = "") -> float:
     """Minimum absolute delta for ``name`` to gate; ``series`` is the
-    round's headline ``metric`` name, selecting the multichip floor table
-    for MULTICHIP_BENCH rounds (the two series share metric names)."""
+    round's headline ``metric`` name, selecting the multichip/soak floor
+    tables for those rounds (the series share metric names)."""
     low = name.lower()
     if series.lower().startswith("multichip"):
         for suffix, floor in _MULTICHIP_NOISE_FLOORS:
+            if low.endswith(suffix):
+                return floor
+    if series.lower().startswith("soak"):
+        for suffix, floor in _SOAK_NOISE_FLOORS:
             if low.endswith(suffix):
                 return floor
     for suffix, floor in _NOISE_FLOORS:
@@ -193,8 +222,9 @@ def analyze_history(
     out: list[Regression] = []
     for (l0, m0), (l1, m1) in zip(rounds, rounds[1:]):
         same_headline = m0.get("_metric_name") == m1.get("_metric_name")
+        series = str(m0.get("_metric_name") or m1.get("_metric_name") or "")
         for name in sorted(set(m0) & set(m1)):
-            direction = metric_direction(name)
+            direction = metric_direction(name, series)
             if direction is None:
                 continue
             if name in _HEADLINE_KEYS and not same_headline:
@@ -204,7 +234,6 @@ def analyze_history(
                 continue
             pct = (cur - prev) / abs(prev)
             bad = pct > threshold if direction < 0 else pct < -threshold
-            series = str(m0.get("_metric_name") or m1.get("_metric_name") or "")
             if not bad or abs(cur - prev) <= noise_floor(name, series):
                 continue
             r = Regression(metric=name, frm=l0, to=l1, prev=prev, cur=cur, pct=pct)
@@ -223,10 +252,11 @@ def compare_rounds(
     human-readable strings for changes beyond ``threshold`` in the bad
     direction (noise floors applied)."""
     same_headline = prev.get("_metric_name") == cur.get("_metric_name")
+    series = str(prev.get("_metric_name") or cur.get("_metric_name") or "")
     deltas: dict[str, float] = {}
     regs: list[str] = []
     for name in sorted(set(prev) & set(cur)):
-        direction = metric_direction(name)
+        direction = metric_direction(name, series)
         if direction is None:
             continue
         if name in _HEADLINE_KEYS and not same_headline:
@@ -237,7 +267,6 @@ def compare_rounds(
         pct = (c - p) / abs(p)
         deltas[name] = round(pct, 4)
         bad = pct > threshold if direction < 0 else pct < -threshold
-        series = str(prev.get("_metric_name") or cur.get("_metric_name") or "")
         if bad and abs(c - p) > noise_floor(name, series):
             regs.append(f"{name} {p:g} -> {c:g} ({pct * 100:+.1f}%)")
     return deltas, regs
@@ -246,7 +275,10 @@ def compare_rounds(
 def format_history(rounds: list[tuple[str, dict[str, float]]],
                    regressions: list[Regression]) -> str:
     labels = [l for l, _ in rounds]
-    names = sorted({n for _, m in rounds for n in m if metric_direction(n) is not None})
+    series = str(next((m.get("_metric_name") for _, m in rounds
+                       if m.get("_metric_name")), ""))
+    names = sorted({n for _, m in rounds for n in m
+                    if metric_direction(n, series) is not None})
     w = max((len(n) for n in names), default=10)
     lines = ["bench history: " + " -> ".join(labels),
              f"  {'metric':<{w}} " + " ".join(f"{l:>10}" for l in labels)]
@@ -255,7 +287,7 @@ def format_history(rounds: list[tuple[str, dict[str, float]]],
         for _, m in rounds:
             v = m.get(n)
             cells.append(f"{v:>10.4g}" if v is not None else f"{'-':>10}")
-        arrow = {1: "^", -1: "v"}[metric_direction(n)]
+        arrow = {1: "^", -1: "v"}[metric_direction(n, series)]
         lines.append(f"  {n:<{w}} " + " ".join(cells) + f"  [{arrow}]")
     if regressions:
         lines.append("")

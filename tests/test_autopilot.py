@@ -1,0 +1,766 @@
+"""Fleet autopilot (ISSUE 11): the policy-driven fault control plane.
+
+Policy-table decisions and hysteresis ladders (per-suspect strike counts,
+window decay, straggler-flag escalation), serialized recoveries (one
+actuator at a time, asserted on recorded intervals), the autopiloted
+training driver on the virtual 8-device mesh — host loss → shrink,
+collective hang → same-mesh resume, persistent SDC → shrink, preemption →
+checkpoint-and-halt + restart, regrow after a healthy window — including
+the OVERLAPPING-fault scenarios (a second fault arriving before the first
+recovery finished), the `autopilot_decision`/`goodput` event schema and
+the `events.unactuated-decision` correlation rule, the watchdog
+abandoned-worker cap and the `.corrupt.N` retention satellites, and the
+soak driver's seeded schedule generator.
+
+Runs in-process on the 8-virtual-device CPU platform (tests/conftest.py).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import thunder_tpu.monitor as monitor
+from thunder_tpu.resilience import autopilot as ap_mod
+from thunder_tpu.resilience import chaos, watchdog
+from thunder_tpu.resilience.autopilot import (
+    Autopilot,
+    AutopilotHalt,
+    Signal,
+    run_autopiloted_training,
+    shrink_shape,
+)
+from thunder_tpu.resilience.preemption import (
+    CheckpointManager,
+    HostLost,
+    Preempted,
+    run_training,
+)
+from thunder_tpu.resilience.watchdog import (
+    CollectiveTimeoutError,
+    SDCDetectedError,
+    SDCGuard,
+)
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                       "scripts")
+
+
+@pytest.fixture(autouse=True)
+def _isolation(monkeypatch):
+    """No ambient chaos/watchdog/metrics/autopilot; abandoned workers
+    drained between tests so the cap satellite cannot leak across."""
+    monkeypatch.setenv("THUNDER_TPU_RETRY_BACKOFF_S", "0")
+    monkeypatch.delenv("THUNDER_TPU_CHAOS", raising=False)
+    monkeypatch.delenv("THUNDER_TPU_COLLECTIVE_TIMEOUT_S", raising=False)
+    monkeypatch.delenv("THUNDER_TPU_WATCHDOG_MAX_ABANDONED", raising=False)
+    chaos.reset_env_config()
+    watchdog.configure(None)
+    watchdog.note_host_health(None)
+    watchdog._abandoned.clear()
+    ap_mod.install(None)
+    was = monitor.enabled()
+    monitor.disable()
+    monitor.reset()
+    yield
+    monitor.reset()
+    (monitor.enable if was else monitor.disable)()
+    ap_mod.install(None)
+    watchdog.configure(None)
+    watchdog._abandoned.clear()
+    chaos.reset_env_config()
+
+
+def _events(path):
+    return [json.loads(line) for line in open(path)]
+
+
+def _kinds(path):
+    return [r["kind"] for r in _events(path)]
+
+
+# =============================================================================
+# Policy engine
+# =============================================================================
+
+
+class TestPolicyEngine:
+    def test_default_table_first_rung(self):
+        ap = Autopilot(clock=lambda: 0.0)
+        for kind, actuator, mode in (
+            ("host_loss", "elastic_resume", "shrink"),
+            ("collective_hang", "elastic_resume", "same_mesh"),
+            ("sdc_suspect", "quarantine_rerun", None),
+            ("sdc_persistent", "elastic_resume", "shrink"),
+            ("oom", "deopt_escalate", None),
+            ("compile_fail", "deopt_escalate", None),
+            ("preempt", "checkpoint_halt", None),
+        ):
+            d = ap.decide(Signal(kind))
+            assert (d.actuator, d.mode) == (actuator, mode), kind
+
+    def test_hysteresis_ladder_climbs_and_decays(self):
+        now = {"t": 0.0}
+        ap = Autopilot(clock=lambda: now["t"])
+        rungs = [ap.decide(Signal("collective_hang", suspect_host=1)).mode
+                 for _ in range(3)]
+        assert rungs == ["same_mesh", "shrink", None]  # third rung halts
+        assert ap.decisions[-1].actuator == "checkpoint_halt"
+        # Outside the window the strike count decays back to rung 0.
+        now["t"] = 1000.0
+        d = ap.decide(Signal("collective_hang", suspect_host=1))
+        assert (d.actuator, d.mode, d.rung) == ("elastic_resume", "same_mesh", 0)
+
+    def test_hysteresis_keyed_per_suspect_host(self):
+        ap = Autopilot(clock=lambda: 0.0)
+        assert ap.decide(Signal("collective_hang", suspect_host=1)).rung == 0
+        # A different flapping host has its own strike history.
+        assert ap.decide(Signal("collective_hang", suspect_host=5)).rung == 0
+        assert ap.decide(Signal("collective_hang", suspect_host=1)).rung == 1
+
+    def test_flagged_straggler_skips_gentle_rung(self):
+        """host_health spread-ratio subscription → a host the observatory
+        measured slow twice gets no same-mesh retry when it hangs."""
+        ap = Autopilot(clock=lambda: 0.0, health_strikes=2)
+        summary = {"spread_ratio": 3.0, "stragglers": [2]}
+        ap.note_host_health(summary)
+        assert ap.flagged_stragglers() == set()  # one strike: not yet
+        ap.note_host_health(summary)
+        assert ap.flagged_stragglers() == {2}
+        d = ap.decide(Signal("collective_hang", suspect_host=2))
+        assert (d.mode, d.rung) == ("shrink", 1)
+        # An unrelated host still gets the gentle rung.
+        assert ap.decide(Signal("collective_hang", suspect_host=0)).rung == 0
+        # A clean summary clears the flag.
+        ap.note_host_health({"spread_ratio": 1.0, "stragglers": []})
+        assert ap.flagged_stragglers() == set()
+
+    def test_host_health_feeds_installed_autopilot(self):
+        """The production wiring: analysis/events.host_health pushes its
+        summary to the INSTALLED autopilot, not just the watchdog."""
+        ap = Autopilot(health_strikes=1)
+        records = [
+            {"kind": "step_time", "host": h, "s": (0.5 if h == 2 else 0.1),
+             "fn": "step", "step": s}
+            for h in range(4) for s in range(3)
+        ]
+        with ap.installed():
+            summary, _ = monitor.host_health(records)
+        assert summary["stragglers"] == [2]
+        assert ap.flagged_stragglers() == {2}
+
+    def test_unknown_signal_halts(self):
+        ap = Autopilot()
+        d = ap.decide(Signal("cosmic_ray_in_the_scheduler"))
+        assert d.actuator == "checkpoint_halt"
+
+    def test_decision_event_and_metric(self, tmp_path):
+        from thunder_tpu.observability import metrics as obsm
+
+        log = str(tmp_path / "ev.jsonl")
+        monitor.set_event_log(log)
+        monitor.enable()
+        try:
+            ap = Autopilot()
+            ap.decide(Signal("host_loss", step=7, suspect_host=3,
+                             evidence={"path": "/ck"}))
+        finally:
+            monitor.set_event_log(None)
+        rec = next(r for r in _events(log) if r["kind"] == "autopilot_decision")
+        assert rec["decision_id"] == 1
+        assert rec["signal"] == "host_loss"
+        assert rec["actuator"] == "elastic_resume"
+        assert rec["mode"] == "shrink"
+        assert rec["step"] == 7 and rec["suspect_host"] == 3
+        assert rec["evidence"] == {"path": "/ck"}
+        assert obsm.AUTOPILOT_DECISIONS.value(actuator="elastic_resume") == 1
+
+    def test_signal_from_exception(self):
+        ap = Autopilot()
+        s = ap.signal_from_exception(HostLost(4, "/ck"))
+        assert (s.kind, s.step) == ("host_loss", 4)
+        s = ap.signal_from_exception(Preempted(9, "/ck"))
+        assert (s.kind, s.step) == ("preempt", 9)
+        s = ap.signal_from_exception(
+            CollectiveTimeoutError("step", 1.0, ["L3.synchronize"], 2))
+        assert (s.kind, s.suspect_host) == ("collective_hang", 2)
+        assert s.evidence["lines"] == ["L3.synchronize"]
+        s = ap.signal_from_exception(SDCDetectedError(5, ["leaf0"]))
+        assert (s.kind, s.step, s.evidence["leaves"]) == \
+            ("sdc_persistent", 5, ["leaf0"])
+
+    def test_shrink_shape(self):
+        assert shrink_shape({"fsdp": 4, "tp": 2}) == {"fsdp": 2, "tp": 2}
+        assert shrink_shape({"fsdp": 1, "tp": 2}) == {"fsdp": 1, "tp": 1}
+        assert shrink_shape({"fsdp": 1, "tp": 1}) is None
+        assert shrink_shape({"dp": 8}) == {"dp": 4}
+
+
+# =============================================================================
+# Serialized recoveries
+# =============================================================================
+
+
+class TestSerialization:
+    def test_recoveries_serialize_across_threads(self):
+        now = time.monotonic
+        ap = Autopilot(clock=now)
+        d1 = ap.decide(Signal("host_loss"))
+        d2 = ap.decide(Signal("collective_hang"))
+
+        def apply(decision):
+            with ap.recovery(decision):
+                time.sleep(0.15)
+
+        t1 = threading.Thread(target=apply, args=(d1,))
+        t2 = threading.Thread(target=apply, args=(d2,))
+        t1.start()
+        time.sleep(0.03)  # t1 holds the recovery lock first
+        t2.start()
+        t1.join()
+        t2.join()
+        assert len(ap.recovery_intervals) == 2
+        (a0, a1, _), (b0, b1, _) = sorted(ap.recovery_intervals)
+        assert a1 <= b0  # one actuator at a time: intervals never overlap
+        assert ap.stats()["serialized_waits"] >= 1
+
+    def test_nested_recovery_same_thread_is_one_chain(self):
+        ap = Autopilot()
+        d1 = ap.decide(Signal("sdc_suspect"))
+        d2 = ap.decide(Signal("collective_hang"))
+        with ap.recovery(d1):
+            with ap.recovery(d2):  # reentrant: a recovery-caused fault
+                pass
+        assert len(ap.recovery_intervals) == 2
+        assert ap.stats()["serialized_waits"] == 0
+
+
+# =============================================================================
+# Decision correlation in replay
+# =============================================================================
+
+
+class TestDecisionReplay:
+    def _replay(self, recs, **kw):
+        import tempfile
+
+        from thunder_tpu.analysis.events import replay_events
+
+        path = os.path.join(tempfile.mkdtemp(), "log.jsonl")
+        with open(path, "w") as f:
+            for i, r in enumerate(recs):
+                base = {"v": 1, "ts": float(i), "seq": i, "pid": 1, "host": 0}
+                base.update(r)
+                f.write(json.dumps(base) + "\n")
+        return replay_events(path, **kw)
+
+    def _decision(self, actuator, **kw):
+        rec = {"kind": "autopilot_decision", "decision_id": 1,
+               "signal": "host_loss", "actuator": actuator}
+        rec.update(kw)
+        return rec
+
+    def test_new_kinds_validate(self):
+        _, diags = self._replay([
+            self._decision("elastic_resume", mode="shrink", step=3),
+            {"kind": "elastic_resume", "step": 3, "from_mesh": {"fsdp": 4},
+             "to_mesh": {"fsdp": 2}, "resharded": True},
+            {"kind": "goodput", "goodput_tokens_per_sec": 123.0,
+             "useful_tokens": 51200, "wall_s": 60.0},
+        ])
+        assert not diags
+
+    def test_unactuated_decision_flagged(self):
+        summary, diags = self._replay([self._decision("elastic_resume")])
+        assert summary["unactuated_decisions"] == ["elastic_resume<-host_loss"]
+        assert any(d.rule == "events.unactuated-decision" for d in diags)
+
+    def test_each_actuator_pairs_with_its_recovery(self):
+        pairs = [
+            ("elastic_resume", {"kind": "elastic_resume", "step": 1,
+                                "from_mesh": None, "to_mesh": None,
+                                "resharded": False}),
+            ("quarantine_rerun", {"kind": "sdc_rerun", "step": 1, "ok": True}),
+            ("deopt_escalate", {"kind": "compile_deopt", "level": 1,
+                                "action": "a", "reason": "r", "attempt": 0}),
+            ("checkpoint_halt", {"kind": "checkpoint_save", "path": "p",
+                                 "step": 1, "ok": True, "attempt": 0}),
+        ]
+        for actuator, recovery in pairs:
+            summary, _ = self._replay([self._decision(actuator), recovery])
+            assert summary["unactuated_decisions"] == [], actuator
+            assert summary["autopilot_decisions"] == {actuator: 1}
+
+    def test_failed_save_does_not_actuate_halt(self):
+        summary, _ = self._replay([
+            self._decision("checkpoint_halt"),
+            {"kind": "checkpoint_save", "path": "p", "step": 1, "ok": False,
+             "attempt": 0},
+        ])
+        assert summary["unactuated_decisions"] == ["checkpoint_halt<-host_loss"]
+
+    def test_superseded_quarantine_actuated_by_elastic_restore(self):
+        """An interrupted SDC re-run is recovered by the restore that
+        discarded the poisoned state — both the decision and the sdc
+        injection accept elastic_resume as recovery."""
+        summary, diags = self._replay([
+            {"kind": "fault_injected", "seam": "sdc", "target": "leaf0", "n": 1},
+            self._decision("quarantine_rerun", signal="sdc_suspect"),
+            {"kind": "elastic_resume", "step": 0, "from_mesh": None,
+             "to_mesh": None, "resharded": False},
+        ])
+        assert summary["unactuated_decisions"] == []
+        assert summary["unrecovered_faults"] == []
+
+
+# =============================================================================
+# The autopiloted training driver (8-device virtual mesh)
+# =============================================================================
+
+
+def _mesh_step(mesh, specs):
+    """A pure-jax step over mesh-sharded state (no trace pipeline — fast)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    shd = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+
+    @jax.jit
+    def _step(state):
+        grad = jax.grad(lambda s: jnp.mean((s["w"] @ s["b"]) ** 2))(state)
+        new = {k: state[k] - 0.1 * grad[k] for k in state}
+        loss = jnp.mean((state["w"] @ state["b"]) ** 2)
+        return new, loss
+
+    def step_fn(state):
+        new, loss = _step(state)
+        new = {k: jax.device_put(v, shd[k]) for k, v in new.items()}
+        return new, float(np.asarray(loss))
+
+    return step_fn
+
+
+class TestAutopilotDriver:
+    def _setup(self, tmp_path, name="ck"):
+        from jax.sharding import PartitionSpec as P
+
+        from thunder_tpu.parallel import make_mesh
+        from thunder_tpu.parallel.sharding import shard_pytree
+
+        mesh = make_mesh(fsdp=4, tp=2)
+        specs = {"w": P("fsdp", "tp"), "b": P()}
+        w = (np.arange(32, dtype=np.float32).reshape(8, 4) * 0.01)
+        state0 = shard_pytree({"w": w, "b": np.ones(4, np.float32)}, mesh, specs)
+        mgr = CheckpointManager(str(tmp_path / name))
+        return mesh, specs, state0, mgr
+
+    def _drive(self, tmp_path, spec, n=6, name="ck", ap=None, specs_hook=None,
+               **kw):
+        mesh, specs, state0, mgr = self._setup(tmp_path, name)
+        ap = ap or Autopilot()
+
+        def build(m):
+            return _mesh_step(m, specs)
+
+        def specs_for(m):
+            if specs_hook is not None:
+                specs_hook(m)
+            return specs
+
+        with chaos.chaos_scope(spec):
+            state, report = run_autopiloted_training(
+                ap, build, state0, n, manager=mgr, mesh=mesh,
+                specs_for_mesh=specs_for, **kw,
+            )
+        return ap, state, report, mgr
+
+    def _baseline(self, tmp_path, n=6):
+        mesh, specs, state0, mgr = self._setup(tmp_path, "base")
+        _, losses = run_training(_mesh_step(mesh, specs), state0, n, manager=mgr)
+        return losses
+
+    def test_host_loss_shrinks_and_continues(self, tmp_path):
+        baseline = self._baseline(tmp_path)
+        log = str(tmp_path / "ev.jsonl")
+        monitor.set_event_log(log)
+        try:
+            ap, _, report, _ = self._drive(tmp_path, "host_loss@2")
+        finally:
+            monitor.set_event_log(None)
+        assert report.halted is None
+        assert [d.actuator for d in report.decisions] == ["elastic_resume"]
+        assert report.decisions[0].mode == "shrink"
+        assert report.final_mesh_shape["fsdp"] == 2
+        # Step losses continue the uninterrupted trajectory (reduction-order
+        # tolerance on the shrunk mesh, as in the PR 9 elastic tests).
+        np.testing.assert_allclose(report.losses, baseline, rtol=1e-5)
+        from thunder_tpu.analysis.events import replay_events
+
+        summary, _ = replay_events(log, storm_threshold=16)
+        assert summary["unrecovered_faults"] == []
+        assert summary["unactuated_decisions"] == []
+
+    def test_collective_hang_resumes_same_mesh(self, tmp_path):
+        log = str(tmp_path / "ev.jsonl")
+        monitor.set_event_log(log)
+        try:
+            # A ~5ms step under a 0.5s timeout: only the injected 3s hang
+            # can trip the watchdog (0.2s proved flaky right after the
+            # orbax restore, which briefly steals the CPU mesh's threads).
+            ap, _, report, _ = self._drive(
+                tmp_path, "collective_hang~3.0", save_every=2,
+                watchdog_timeout_s=0.5,
+            )
+        finally:
+            monitor.set_event_log(None)
+        assert report.halted is None
+        hang = [d for d in report.decisions
+                if d.signal.kind == "collective_hang"]
+        assert len(hang) == 1 and hang[0].mode == "same_mesh"
+        assert report.final_mesh_shape["fsdp"] == 4  # never shrank
+        kinds = _kinds(log)
+        assert "collective_timeout" in kinds
+        # The same-mesh elastic_resume recovery event follows the decision.
+        from thunder_tpu.analysis.events import replay_events
+
+        summary, _ = replay_events(log, storm_threshold=16)
+        assert summary["unactuated_decisions"] == []
+        assert summary["unrecovered_faults"] == []
+
+    def test_persistent_sdc_shrinks_away(self, tmp_path):
+        log = str(tmp_path / "ev.jsonl")
+        monitor.set_event_log(log)
+        try:
+            ap, _, report, _ = self._drive(
+                tmp_path, "sdc*3", sdc_guard=SDCGuard(max_reruns=1),
+            )
+        finally:
+            monitor.set_event_log(None)
+        assert report.halted is None
+        by = ap.stats()["by_actuator"]
+        assert by["quarantine_rerun"] >= 1
+        assert by["elastic_resume"] == 1  # the sdc_persistent shrink
+        shrink = [d for d in report.decisions
+                  if d.signal.kind == "sdc_persistent"]
+        assert len(shrink) == 1 and shrink[0].mode == "shrink"
+        from thunder_tpu.analysis.events import replay_events
+
+        summary, _ = replay_events(log, storm_threshold=16)
+        assert summary["unrecovered_faults"] == []
+        assert summary["unactuated_decisions"] == []
+
+    def test_preempt_halts_then_restart_completes(self, tmp_path):
+        log = str(tmp_path / "ev.jsonl")
+        monitor.set_event_log(log)
+        try:
+            with pytest.raises(AutopilotHalt) as ei:
+                self._drive(tmp_path, "preempt@2")
+            halt_report = ei.value.report
+            assert halt_report is not None
+            halts = [d for d in halt_report.decisions
+                     if d.actuator == "checkpoint_halt"]
+            assert len(halts) == 1 and halts[0].signal.kind == "preempt"
+            # "The next allocation": a fresh driver call resumes from the
+            # durable checkpoint and completes.
+            ap2, _, report, _ = self._drive(tmp_path, "")
+            assert report.halted is None
+            assert all(l is None for l in report.losses[:2])  # not re-run
+            assert all(l is not None for l in report.losses[2:])
+        finally:
+            monitor.set_event_log(None)
+        from thunder_tpu.analysis.events import replay_events
+
+        summary, _ = replay_events(log, storm_threshold=16)
+        assert summary["unrecovered_faults"] == []
+        assert summary["unactuated_decisions"] == []
+
+    def test_overlap_host_loss_after_sdc_rerun_serializes(self, tmp_path):
+        """ISSUE 11 satellite: host_loss landing right as the SDC re-run
+        completes — two recoveries back to back, applied one at a time,
+        with zero unrecovered faults in replay."""
+        log = str(tmp_path / "ev.jsonl")
+        monitor.set_event_log(log)
+        try:
+            ap, _, report, _ = self._drive(
+                tmp_path, "sdc*2;host_loss@1",
+                sdc_guard=SDCGuard(max_reruns=2),
+            )
+        finally:
+            monitor.set_event_log(None)
+        assert report.halted is None
+        actuators = [d.actuator for d in report.decisions]
+        assert "quarantine_rerun" in actuators
+        assert "elastic_resume" in actuators
+        # Serialized: recorded recovery intervals never overlap.
+        ivals = sorted(ap.recovery_intervals)
+        for (s0, e0, _), (s1, e1, _) in zip(ivals, ivals[1:]):
+            assert e0 <= s1
+        from thunder_tpu.analysis.events import replay_events
+
+        summary, _ = replay_events(log, storm_threshold=16)
+        assert summary["unrecovered_faults"] == []
+        assert summary["unactuated_decisions"] == []
+
+    def test_overlap_hang_during_elastic_resume(self, tmp_path):
+        """ISSUE 11 satellite: a collective hang arriving DURING the
+        elastic resume a host loss triggered — the hang is decided after
+        the elastic recovery completes (serialized), then recovered on the
+        resumed mesh."""
+        log = str(tmp_path / "ev.jsonl")
+        armed = {"done": False}
+
+        def arm_hang_on_shrink(mesh):
+            # Called inside the elastic_resume application (while the
+            # shrink recovery holds the serialization lock): plant the hang
+            # so it fires on the first guarded dispatch after the resume.
+            from thunder_tpu.parallel.mesh import axis_sizes
+
+            if not armed["done"] and axis_sizes(mesh).get("fsdp") == 2:
+                armed["done"] = True
+                cfg = chaos.active()
+                cfg.rules.append(chaos.FaultRule("collective_hang", delay_s=3.0))
+
+        monitor.set_event_log(log)
+        try:
+            ap, _, report, _ = self._drive(
+                tmp_path, "host_loss@1", specs_hook=arm_hang_on_shrink,
+                watchdog_timeout_s=0.5,
+            )
+        finally:
+            monitor.set_event_log(None)
+        assert report.halted is None
+        kinds = [d.signal.kind for d in report.decisions]
+        assert kinds[0] == "host_loss"
+        assert "collective_hang" in kinds
+        ivals = sorted(ap.recovery_intervals)
+        for (s0, e0, _), (s1, e1, _) in zip(ivals, ivals[1:]):
+            assert e0 <= s1  # one actuator at a time
+        from thunder_tpu.analysis.events import replay_events
+
+        summary, _ = replay_events(log, storm_threshold=16)
+        assert summary["unrecovered_faults"] == []
+        assert summary["unactuated_decisions"] == []
+
+    def test_regrow_after_healthy_window(self, tmp_path):
+        ap, _, report, _ = self._drive(
+            tmp_path, "host_loss@1", n=8, regrow_after=2,
+        )
+        assert report.halted is None
+        modes = [(d.signal.kind, d.mode) for d in report.decisions]
+        assert ("host_loss", "shrink") in modes
+        assert ("host_recovered", "regrow") in modes
+        assert report.final_mesh_shape["fsdp"] == 4  # back on the full mesh
+
+
+# =============================================================================
+# Satellite: watchdog abandoned-worker cap
+# =============================================================================
+
+
+class TestWatchdogAbandonedCap:
+    def test_cap_refuses_to_arm_then_recovers(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_WATCHDOG_MAX_ABANDONED", "1")
+        with chaos.chaos_scope("collective_hang~0.6*2"):
+            with pytest.raises(CollectiveTimeoutError):
+                watchdog.guard_call(lambda: 1, (), fn_name="a", timeout_s=0.05)
+            assert watchdog.abandoned_worker_count() == 1
+            # Cap reached: the next dispatch runs UNguarded (no worker, no
+            # timeout) with a warning — bounded leak instead of a thread
+            # per timeout.
+            with pytest.warns(RuntimeWarning, match="abandoned worker"):
+                assert watchdog.guard_call(
+                    lambda: 42, (), fn_name="b", timeout_s=0.05) == 42
+            assert watchdog.abandoned_worker_count() == 1
+        # Once the hung worker exits, arming resumes.
+        deadline = time.monotonic() + 5.0
+        while watchdog.abandoned_worker_count() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert watchdog.abandoned_worker_count() == 0
+        assert watchdog.guard_call(lambda: 7, (), fn_name="c", timeout_s=5.0) == 7
+
+    def test_unguarded_metric(self, monkeypatch):
+        from thunder_tpu.observability import metrics as obsm
+
+        monitor.enable()
+        monkeypatch.setenv("THUNDER_TPU_WATCHDOG_MAX_ABANDONED", "0")
+        with pytest.warns(RuntimeWarning):
+            watchdog.guard_call(lambda: 1, (), fn_name="m", timeout_s=1.0)
+        assert obsm.WATCHDOG_UNGUARDED.value() == 1
+
+
+# =============================================================================
+# Satellite: .corrupt.N quarantine retention
+# =============================================================================
+
+
+class TestCorruptRetention:
+    def _fake_quarantine(self, mgr, name, age):
+        d = os.path.join(mgr.directory, name)
+        os.makedirs(d)
+        now = time.time()
+        os.utime(d, (now - age, now - age))
+        return d
+
+    def test_quarantines_fold_into_retention_sweep(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        old = [self._fake_quarantine(mgr, f"step_0000000{i}.corrupt", 100 - i)
+               for i in range(3)]
+        newer = self._fake_quarantine(mgr, "step_00000001.corrupt.1", 10)
+        newest = self._fake_quarantine(mgr, "step_00000001.corrupt.2", 1)
+        mgr.save({"x": np.ones(2, np.float32)}, 7)
+        left = sorted(n for n in os.listdir(mgr.directory) if ".corrupt" in n)
+        assert left == ["step_00000001.corrupt.1", "step_00000001.corrupt.2"]
+        assert all(not os.path.exists(p) for p in old)
+        assert os.path.exists(newer) and os.path.exists(newest)
+
+    def test_repeated_corruption_stays_bounded(self, tmp_path):
+        """The soak scenario: corrupt → quarantine → resave, repeatedly —
+        the directory must not grow without limit."""
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        state = {"x": np.ones(2, np.float32)}
+        for round_ in range(5):
+            mgr.save(state, round_ + 1)
+            # Corrupt the payload so restore quarantines it.
+            step_dir = mgr._step_dir(round_ + 1)
+            for root, _, files in os.walk(step_dir):
+                for f in files:
+                    if f != mgr.META:
+                        open(os.path.join(root, f), "w").close()
+            try:
+                mgr.restore()
+            except Exception:
+                pass
+            time.sleep(0.01)  # distinct quarantine mtimes
+        mgr.save(state, 99)
+        quarantined = [n for n in os.listdir(mgr.directory) if ".corrupt" in n]
+        assert len(quarantined) <= 2  # folded into keep=2 retention
+
+    def test_quarantine_sweep_is_primary_only(self, tmp_path, monkeypatch):
+        from thunder_tpu.resilience import preemption
+
+        mgr = CheckpointManager(str(tmp_path), keep=1)
+        for i in range(3):
+            self._fake_quarantine(mgr, f"step_0000000{i}.corrupt", 50 - i)
+        monkeypatch.setattr(preemption, "_is_primary", lambda: False)
+        mgr.save({"x": np.ones(2, np.float32)}, 5)
+        assert len([n for n in os.listdir(mgr.directory)
+                    if ".corrupt" in n]) == 3  # non-primary never GCs
+
+
+# =============================================================================
+# Soak schedule generator + goodput accounting
+# =============================================================================
+
+
+class TestSoakSchedule:
+    @pytest.fixture(autouse=True)
+    def _scripts_path(self):
+        if SCRIPTS not in sys.path:
+            sys.path.insert(0, SCRIPTS)
+        yield
+
+    def test_deterministic_per_seed(self):
+        import soak_fleet as sf
+
+        a = sf.make_schedule(7, 200, 14)
+        b = sf.make_schedule(7, 200, 14)
+        c = sf.make_schedule(8, 200, 14)
+        assert [(f.step, f.seam) for f in a] == [(f.step, f.seam) for f in b]
+        assert [(f.step, f.seam) for f in a] != [(f.step, f.seam) for f in c]
+
+    def test_coverage_and_overlap(self):
+        import soak_fleet as sf
+
+        for seed in (1, 7, 23):
+            sched = sf.make_schedule(seed, 200, 14, overlap_pairs=2)
+            assert len(sched) == 14
+            seams = {f.seam for f in sched}
+            assert set(sf.REQUIRED_SEAMS) <= seams  # every policy class
+            assert sf.overlapping_pairs(sched) >= 2
+            by = [f.seam for f in sched]
+            assert by.count("preempt") == 1  # one restart per soak
+            assert by.count("oom") <= 3  # the de-opt ladder's depth
+            assert all(3 <= f.step for f in sched)
+            # A preempt never shares its trigger step (its recovery is a
+            # process exit).
+            steps = {}
+            for f in sched:
+                steps.setdefault(f.step, []).append(f.seam)
+            for step, seams_at in steps.items():
+                if "preempt" in seams_at:
+                    assert seams_at == ["preempt"]
+
+    def test_preempt_never_in_overlap_tail(self):
+        """With overlap_pairs close to n_faults - len(REQUIRED_SEAMS), the
+        preempt must still land in the slot region (its own trigger step) —
+        co-scheduling it would strand the partner fault's recovery in a
+        process that just halted."""
+        import soak_fleet as sf
+
+        for seed in range(6):
+            sched = sf.make_schedule(seed, 60, 7, overlap_pairs=4)
+            steps = {}
+            for f in sched:
+                steps.setdefault(f.step, []).append(f.seam)
+            for seams_at in steps.values():
+                if "preempt" in seams_at:
+                    assert seams_at == ["preempt"]
+
+    def test_arm_fault_rules(self):
+        import soak_fleet as sf
+
+        from thunder_tpu.resilience.chaos import ChaosConfig
+
+        cfg = ChaosConfig(rules=[], seed=0)
+        for seam, step in (("host_loss", 5), ("preempt", 9)):
+            sf.arm_fault(cfg, sf.ScheduledFault(step, seam), hang_delay_s=12.0)
+        sf.arm_fault(cfg, sf.ScheduledFault(3, "collective_hang"),
+                     hang_delay_s=12.0)
+        sf.arm_fault(cfg, sf.ScheduledFault(3, "sdc"), hang_delay_s=12.0)
+        by = {r.seam: r for r in cfg.rules}
+        assert by["host_loss"].target == "6"  # fires at the NEXT boundary
+        assert by["preempt"].target == "10"
+        assert by["collective_hang"].delay_s == 12.0
+        assert by["sdc"].target is None and by["sdc"].count == 1
+
+    def test_soak_ok_gate(self):
+        import soak_fleet as sf
+
+        good = {"soak_unrecovered": 0, "soak_unactuated": 0,
+                "soak_replay_errors": 0, "soak_final_loss": 0.5}
+        assert sf.soak_ok(good)
+        assert not sf.soak_ok({**good, "soak_unrecovered": 1})
+        assert not sf.soak_ok({**good, "soak_unactuated": 2})
+        assert not sf.soak_ok({**good, "soak_final_loss": float("nan")})
+
+    def test_soak_noise_floors_and_direction(self):
+        import perf_report as pr
+
+        # The SOAK headline `value` is goodput: UP-good, unlike every other
+        # series where value is a time.
+        assert pr.metric_direction("value", "soak_goodput") == 1
+        assert pr.metric_direction("value", "multichip_fsdp_tp_train_iter") == -1
+        assert pr.metric_direction("soak_goodput_tokens_per_sec") == 1
+        assert pr.metric_direction("soak_goodput_ratio") == 1
+        assert pr.noise_floor("soak_goodput_ratio", "soak_goodput") == 0.15
+        assert pr.noise_floor("value", "soak_goodput") == 800.0
+        assert pr.noise_floor("soak_recovery_per_fault_s", "soak_goodput") == 2.5
+
+    def test_goodput_gate_flags_drop(self):
+        import perf_report as pr
+
+        r1 = {"_metric_name": "soak_goodput", "value": 5000.0,
+              "soak_goodput_ratio": 0.8}
+        r2 = {"_metric_name": "soak_goodput", "value": 2000.0,
+              "soak_goodput_ratio": 0.3}
+        regs = pr.analyze_history([("r01", r1), ("r02", r2)])
+        names = {r.metric for r in regs}
+        assert "value" in names  # goodput DROP gates
+        # And an improvement does not.
+        regs = pr.analyze_history([("r01", r2), ("r02", r1)])
+        assert not regs

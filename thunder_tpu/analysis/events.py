@@ -58,6 +58,11 @@ SCHEMA: dict[str, frozenset] = {
     "elastic_resume": frozenset({"step", "from_mesh", "to_mesh", "resharded"}),
     "sdc_suspect": frozenset({"step", "leaves"}),
     "sdc_rerun": frozenset({"step", "ok"}),
+    # Fleet autopilot (ISSUE 11; docs/robustness.md "fleet autopilot"): one
+    # record per policy decision, carrying the triggering evidence; the
+    # soak driver summarizes its run with one goodput record.
+    "autopilot_decision": frozenset({"decision_id", "signal", "actuator"}),
+    "goodput": frozenset({"goodput_tokens_per_sec", "useful_tokens", "wall_s"}),
 }
 _COMMON = frozenset({"v", "ts", "seq", "kind"})
 
@@ -81,7 +86,25 @@ FAULT_RECOVERY_KINDS: dict[str, frozenset] = {
     # the guard's quarantine + re-run.
     "collective_hang": frozenset({"collective_timeout"}),
     "host_loss": frozenset({"checkpoint_save"}),
-    "sdc": frozenset({"sdc_rerun"}),
+    # An elastic resume also recovers an SDC injection: the restore
+    # discards the poisoned state wholesale, which is exactly what the
+    # autopilot does when a fresher fault (host loss, hang) interrupts the
+    # guard's re-run mid-flight (ISSUE 11 overlapping-fault scenarios).
+    "sdc": frozenset({"sdc_rerun", "elastic_resume"}),
+}
+
+# Autopilot correlation contract (ISSUE 11): every autopilot_decision must
+# be followed by its actuator's recovery event — a decision with no
+# subsequent actuation means the control plane chose a recovery that never
+# ran (or the actuator lost its event). checkpoint_halt and
+# quarantine_rerun count only SUCCESSFUL saves/re-runs (ok=true), like the
+# fault-correlation rule; an interrupted quarantine re-run may instead be
+# superseded by an elastic restore, which discards the poisoned state.
+DECISION_RECOVERY_KINDS: dict[str, frozenset] = {
+    "elastic_resume": frozenset({"elastic_resume"}),
+    "quarantine_rerun": frozenset({"sdc_rerun", "elastic_resume"}),
+    "deopt_escalate": frozenset({"compile_deopt"}),
+    "checkpoint_halt": frozenset({"checkpoint_save"}),
 }
 
 
@@ -219,10 +242,16 @@ def host_health(
                 ))
     # Detection → action (ISSUE 9): the collective watchdog names this
     # summary's straggler as the suspected host when a collective later
-    # times out.
+    # times out. The installed autopilot (ISSUE 11) consumes the same
+    # summary — a host flagged in consecutive summaries loses its gentle
+    # same-mesh-retry rung on the next hang.
+    from thunder_tpu.resilience import autopilot as _autopilot
     from thunder_tpu.resilience import watchdog as _watchdog
 
     _watchdog.note_host_health(summary)
+    ap = _autopilot.current()
+    if ap is not None:
+        ap.note_host_health(summary)
     return summary, diags
 
 
@@ -256,6 +285,7 @@ def replay_events(
     buckets: list[str] = []
     sharp_edges: list[str] = []
     fault_events: list[tuple[int, str, dict]] = []  # (lineno, seam, record)
+    decision_events: list[tuple[int, str, dict]] = []  # (lineno, actuator, record)
     recovery_positions: dict[str, list[int]] = {}  # recovery kind -> linenos
     n_lines = 0
 
@@ -351,8 +381,11 @@ def replay_events(
                 sharp_edges.append(str(rec["message"]))
             elif kind == "fault_injected":
                 fault_events.append((lineno, str(rec["seam"]), rec))
+            elif kind == "autopilot_decision":
+                decision_events.append((lineno, str(rec["actuator"]), rec))
             elif kind in ("executor_demoted", "compile_deopt", "nan_guard",
-                          "cache_repair", "collective_timeout"):
+                          "cache_repair", "collective_timeout",
+                          "elastic_resume"):
                 recovery_positions.setdefault(kind, []).append(lineno)
             elif kind in ("checkpoint_save", "sdc_rerun"):
                 # Only a SUCCESSFUL save/re-run proves recovery: a failed
@@ -427,6 +460,33 @@ def replay_events(
                 hint="docs/robustness.md lists the expected recovery event "
                      "per seam",
             ))
+    # Autopilot correlation (ISSUE 11): every decision must be followed by
+    # its actuator's recovery event (DECISION_RECOVERY_KINDS) — the same
+    # shape as the fault rule, one layer up: the control plane's choices
+    # are falsifiable, not just the injections.
+    unactuated: list[str] = []
+    decisions_by_actuator: dict[str, int] = {}
+    for lineno, actuator, rec in decision_events:
+        decisions_by_actuator[actuator] = decisions_by_actuator.get(actuator, 0) + 1
+        expected = DECISION_RECOVERY_KINDS.get(actuator)
+        if not expected:
+            continue
+        if not any(
+            pos > lineno for k in expected for pos in recovery_positions.get(k, [])
+        ):
+            unactuated.append(f"{actuator}<-{rec.get('signal')}")
+            diags.append(Diagnostic(
+                rule="events.unactuated-decision", severity=Severity.ERROR,
+                message=(
+                    f"line {lineno}: autopilot_decision "
+                    f"id={rec.get('decision_id')} actuator={actuator!r} "
+                    f"(signal {rec.get('signal')!r}) has no subsequent "
+                    f"{'/'.join(sorted(expected))} event — the chosen "
+                    f"recovery never ran (or lost its event)"
+                ),
+                hint="docs/robustness.md 'fleet autopilot' lists the "
+                     "recovery event per actuator",
+            ))
 
     summary = {
         "path": src,
@@ -443,6 +503,8 @@ def replay_events(
         "sharp_edges": sharp_edges,
         "faults_injected": [f"{seam}@{rec.get('target')}" for _, seam, rec in fault_events],
         "unrecovered_faults": unrecovered,
+        "autopilot_decisions": decisions_by_actuator,
+        "unactuated_decisions": unactuated,
     }
     return summary, diags
 
@@ -474,6 +536,12 @@ def format_replay(summary: dict, diags: list[Diagnostic]) -> str:
             f"  faults injected: {len(summary['faults_injected'])} "
             f"({', '.join(summary['faults_injected'])}); "
             f"unrecovered: {len(summary.get('unrecovered_faults') or [])}"
+        )
+    if summary.get("autopilot_decisions"):
+        lines.append(
+            "  autopilot decisions: " + ", ".join(
+                f"{a}×{n}" for a, n in sorted(summary["autopilot_decisions"].items())
+            ) + f"; unactuated: {len(summary.get('unactuated_decisions') or [])}"
         )
     for d in diags:
         lines.append("  " + d.format().replace("\n", "\n  "))

@@ -428,6 +428,23 @@ SDC_RERUNS = REGISTRY.counter(
     "thunder_tpu_sdc_reruns_total",
     "Quarantined-step re-runs by the SDC guard, labelled ok=true|false",
 )
+# Fleet autopilot (ISSUE 11; docs/robustness.md "fleet autopilot"): the
+# policy engine's choices, and the soak driver's headline goodput.
+AUTOPILOT_DECISIONS = REGISTRY.counter(
+    "thunder_tpu_autopilot_decisions_total",
+    "Fleet-autopilot policy decisions, labelled by actuator "
+    "(elastic_resume|quarantine_rerun|deopt_escalate|checkpoint_halt)",
+)
+SOAK_GOODPUT = REGISTRY.gauge(
+    "thunder_tpu_soak_goodput_tokens_per_sec",
+    "Soak-run goodput: useful tokens/sec over wall clock, discounted by the "
+    "measured resilience overhead (scripts/soak_fleet.py)",
+)
+WATCHDOG_UNGUARDED = REGISTRY.counter(
+    "thunder_tpu_collective_watchdog_unguarded_total",
+    "Guarded dispatches run UNguarded because the abandoned-worker cap "
+    "(THUNDER_TPU_WATCHDOG_MAX_ABANDONED) was reached",
+)
 # inc_always: a dropped observability sink must be visible even with the
 # metrics gate off — silent loss of the event log is the failure mode this
 # counter exists to expose (monitor.report() lists it unconditionally).

@@ -26,10 +26,23 @@ the runtime actually walk that ladder under fault:
   (cross-replica checksums, quarantine + re-run) — ISSUE 9;
 - :mod:`~thunder_tpu.resilience.elastic` — elastic resharded resume:
   restore a checkpoint written by one mesh shape onto a different
-  (smaller) mesh after a host loss — ISSUE 9.
+  (smaller) mesh after a host loss — ISSUE 9;
+- :mod:`~thunder_tpu.resilience.autopilot` — the fleet autopilot: the
+  policy engine that decides WHICH of the above actuators to apply when
+  faults arrive mixed and concurrent, with per-policy hysteresis and
+  serialized recoveries, every choice a typed ``autopilot_decision``
+  event — ISSUE 11.
 
 See docs/robustness.md for the fault model and the chaos spec grammar.
 """
+
+from thunder_tpu.resilience.autopilot import (  # noqa: F401
+    Autopilot,
+    AutopilotHalt,
+    Policy,
+    Signal,
+    run_autopiloted_training,
+)
 
 from thunder_tpu.resilience.chaos import (  # noqa: F401
     ChaosConfig,
@@ -77,4 +90,6 @@ __all__ = [
     "Preempted", "HostLost",
     "CollectiveTimeoutError", "SDCDetectedError", "SDCGuard",
     "elastic_resume", "reshard_state",
+    "Autopilot", "AutopilotHalt", "Policy", "Signal",
+    "run_autopiloted_training",
 ]

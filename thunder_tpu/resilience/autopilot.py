@@ -1,0 +1,611 @@
+"""Fleet autopilot: the policy engine that picks WHICH recovery to apply.
+
+PRs 6-10 built every fault-tolerance actuator — executor demotion, the
+compile de-opt ladder, the collective watchdog, elastic resharded resume,
+SDC quarantine+rerun, checkpoint-and-halt — but each fires in isolation
+under a hand-written test. In production the faults arrive mixed and
+concurrent: a host flaps, a collective hangs during the elastic resume the
+flap triggered, an SDC rerun is interrupted by a preemption. This module is
+the control plane that sits between the *signal streams* and the
+*actuators* (ISSUE 11):
+
+Signals (normalized into :class:`Signal`):
+
+- ``CollectiveTimeoutError`` verdicts with suspect-host naming (watchdog);
+- ``sdc_suspect`` divergences and persistent :class:`SDCDetectedError`;
+- ``HostLost`` / ``Preempted`` step-boundary faults (preemption);
+- OOM / compile-failure escalations (the de-opt ladder consults the
+  installed autopilot before climbing);
+- ``analysis/events.host_health`` spread-ratio summaries
+  (:meth:`Autopilot.note_host_health`) — a host the observatory already
+  flagged as a straggler skips the gentle same-mesh retry when it later
+  hangs a collective.
+
+Actuators (the four policy classes; ``DECISION_RECOVERY_KINDS`` in
+``analysis/events.py`` names each one's recovery event):
+
+===================  ========================================================
+``elastic_resume``   checkpoint restore via :func:`~.elastic.elastic_resume`
+                     — ``mode`` is ``same_mesh`` (re-dispatch from the last
+                     checkpoint), ``shrink`` (halve an axis, continue on the
+                     survivors), or ``regrow`` (replacement capacity came
+                     back: reshard up to the full mesh)
+``quarantine_rerun`` the SDC guard's quarantine + re-run of a divergent step
+``deopt_escalate``   the compile de-opt ladder climbs/jumps a level
+``checkpoint_halt``  save a durable checkpoint and stop — the next process
+                     (scheduler allocation) resumes
+===================  ========================================================
+
+Every decision is emitted as a typed ``autopilot_decision`` event carrying
+the triggering evidence (signal kind, step, suspect host, hysteresis rung,
+fires-in-window) and must be followed by its actuator's recovery event —
+the replay correlation rule ``events.unactuated-decision`` enforces it,
+exactly like ``events.unrecovered-fault`` does for injections.
+
+**Hysteresis.** Each signal kind has a policy ladder: repeated signals of
+the same kind (keyed by suspect host, so two different flapping hosts don't
+share a strike count) within ``window_s`` climb the ladder — e.g. a first
+collective hang retries on the same mesh, a second within the window
+shrinks the mesh away from the suspect, a third halts. Outside the window
+the count decays back to the first rung. ``backoff_s`` spaces actuator
+applications so a flapping host cannot thrash resume loops.
+
+**Serialization.** Recoveries apply one at a time: actuator applications
+run inside :meth:`Autopilot.recovery`, a reentrant-per-thread critical
+section (a recovery that *causes* another fault handles it as one nested
+chain; a concurrent thread's recovery waits). The recorded
+``recovery_intervals`` let tests assert no two actuators overlapped.
+
+Driver: :func:`run_autopiloted_training` wraps
+:func:`~.preemption.run_training` in the decide→apply loop; the soak
+harness (``scripts/soak_fleet.py``) runs it for hundreds of steps under a
+seeded mixed-fault schedule and commits the resulting **goodput** number.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from thunder_tpu.observability import events as obs_events
+from thunder_tpu.observability import metrics as obsm
+
+ACTUATORS = (
+    "elastic_resume", "quarantine_rerun", "deopt_escalate", "checkpoint_halt",
+)
+
+# Signal kinds the default policy table covers. Unknown kinds fall through
+# to checkpoint_halt: an unclassified fault must degrade to the safest
+# actuator (durable state, loud stop), never be silently retried.
+SIGNAL_KINDS = (
+    "host_loss", "collective_hang", "sdc_suspect", "sdc_persistent",
+    "oom", "compile_fail", "preempt", "host_unhealthy",
+)
+
+
+class AutopilotHalt(RuntimeError):
+    """The autopilot chose ``checkpoint_halt``: a durable checkpoint exists
+    and this process should exit; the next allocation resumes from it."""
+
+    def __init__(self, step: int, reason: str, decision=None):
+        self.step = step
+        self.reason = reason
+        self.decision = decision
+        self.report: Optional["AutopilotReport"] = None  # attached by the driver
+        super().__init__(
+            f"autopilot halt at step {step}: {reason} — checkpoint is "
+            f"durable; resume in a fresh process"
+        )
+
+
+@dataclass
+class Signal:
+    """One normalized fault/health observation the policy engine decides on.
+    ``suspect_host`` keys the hysteresis history (per-host strike counts);
+    ``evidence`` is free-form and lands verbatim in the decision event."""
+
+    kind: str
+    step: Optional[int] = None
+    suspect_host: Optional[Any] = None
+    evidence: dict = field(default_factory=dict)
+
+
+@dataclass
+class Policy:
+    """Hysteresis ladder for one signal kind: the Nth signal within
+    ``window_s`` (keyed by suspect host) applies ``ladder[min(N-1, last)]``.
+    ``backoff_s`` is the base anti-thrash delay before applying the
+    actuator, doubled per rung."""
+
+    signal: str
+    ladder: tuple  # of (actuator, mode-or-None)
+    window_s: float = 300.0
+    backoff_s: float = 0.0
+
+
+def default_policies() -> dict[str, Policy]:
+    """The committed policy table (docs/robustness.md "fleet autopilot")."""
+    return {p.signal: p for p in (
+        # A dead host never comes back by retrying: shrink immediately;
+        # two losses inside the window and the third halts (the mesh is
+        # evaporating faster than it can reshard).
+        Policy("host_loss",
+               (("elastic_resume", "shrink"), ("elastic_resume", "shrink"),
+                ("checkpoint_halt", None)),
+               window_s=600.0),
+        # A hang may be transient (ICI hiccup): first retry the same mesh
+        # from the last checkpoint; a repeat within the window means the
+        # suspect is flapping — shrink away from it; a third halts.
+        Policy("collective_hang",
+               (("elastic_resume", "same_mesh"), ("elastic_resume", "shrink"),
+                ("checkpoint_halt", None)),
+               window_s=120.0),
+        # Transient bit-flips are the SDC guard's job (it bounds its own
+        # reruns); the decision records that the quarantine path was chosen.
+        Policy("sdc_suspect", (("quarantine_rerun", None),), window_s=60.0),
+        # Corruption that survived the rerun budget is a bad device, not a
+        # cosmic ray: shrink away from it, halt if it persists.
+        Policy("sdc_persistent",
+               (("elastic_resume", "shrink"), ("checkpoint_halt", None)),
+               window_s=600.0),
+        # Memory/compile pressure de-opts in place — the ladder itself is
+        # bounded (THUNDER_TPU_MAX_RECOVERY_ATTEMPTS), so no escalation
+        # rung is needed here.
+        Policy("oom", (("deopt_escalate", None),), window_s=60.0),
+        Policy("compile_fail", (("deopt_escalate", None),), window_s=60.0),
+        # Preemption is an order, not a fault: save and stop.
+        Policy("preempt", (("checkpoint_halt", None),), window_s=60.0),
+    )}
+
+
+@dataclass
+class Decision:
+    """One policy-engine verdict, mirrored into an ``autopilot_decision``
+    event. ``rung``/``fires_in_window`` expose the hysteresis state that
+    produced it; the correlation rule pairs it with the actuator's recovery
+    event (``DECISION_RECOVERY_KINDS``)."""
+
+    id: int
+    signal: Signal
+    actuator: str
+    mode: Optional[str] = None
+    rung: int = 0
+    fires_in_window: int = 0
+    window_s: float = 0.0
+    backoff_s: float = 0.0
+
+
+class Autopilot:
+    """The policy engine. One instance drives one training job; install it
+    (:meth:`installed` / :func:`install`) so the seams that cannot take a
+    parameter — the de-opt ladder inside the dispatcher, the SDC guard
+    inside ``run_training`` — find it via :func:`current`.
+
+    ``clock`` is injectable for deterministic hysteresis tests;
+    ``spread_threshold``/``health_strikes`` govern when host-health
+    summaries mark a host as a known straggler (which skips the gentle
+    same-mesh rung on its next collective hang)."""
+
+    def __init__(self, policies: Optional[dict] = None, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 spread_threshold: float = 1.5, health_strikes: int = 2):
+        self.policies = dict(policies) if policies is not None else default_policies()
+        self._clock = clock
+        self.spread_threshold = float(spread_threshold)
+        self.health_strikes = int(health_strikes)
+        self.decisions: list[Decision] = []
+        self.recovery_intervals: list[tuple[float, float, int]] = []
+        self._fires: dict = {}           # (kind, suspect) -> [ts, ...]
+        self._health_strikes: dict = {}  # host -> consecutive flags
+        self._flagged: set = set()       # hosts past the strike budget
+        self._state_lock = threading.Lock()
+        self._serial = threading.RLock()
+        self._owner: Optional[int] = None
+        self._depth = 0
+        self._serialized_waits = 0
+        self._active_decision_id: Optional[int] = None
+
+    # -- signal intake --------------------------------------------------------
+
+    def note_host_health(self, summary: Optional[dict]) -> None:
+        """Consume a ``host_health`` summary (spread ratio + stragglers).
+        A host flagged in ``health_strikes`` consecutive summaries becomes a
+        known straggler: its next ``collective_hang`` decision starts one
+        rung up the ladder (no same-mesh retry for a host the observatory
+        already measured slow)."""
+        if not summary:
+            return
+        with self._state_lock:
+            stragglers = set(summary.get("stragglers") or ())
+            for host in stragglers:
+                n = self._health_strikes.get(host, 0) + 1
+                self._health_strikes[host] = n
+                if n >= self.health_strikes:
+                    self._flagged.add(host)
+            for host in list(self._health_strikes):
+                if host not in stragglers:
+                    self._health_strikes.pop(host, None)
+                    self._flagged.discard(host)
+
+    def flagged_stragglers(self) -> set:
+        with self._state_lock:
+            return set(self._flagged)
+
+    def signal_from_exception(self, exc: BaseException) -> Signal:
+        """Normalize a fault exception raised out of the training loop."""
+        from thunder_tpu.resilience.preemption import HostLost, Preempted
+        from thunder_tpu.resilience.watchdog import (
+            CollectiveTimeoutError,
+            SDCDetectedError,
+        )
+
+        if isinstance(exc, HostLost):
+            return Signal("host_loss", step=exc.step,
+                          evidence={"path": exc.path})
+        if isinstance(exc, Preempted):
+            return Signal("preempt", step=exc.step,
+                          evidence={"path": exc.path})
+        if isinstance(exc, CollectiveTimeoutError):
+            return Signal("collective_hang", suspect_host=exc.suspected_host,
+                          evidence={"fn": exc.fn_name,
+                                    "timeout_s": exc.timeout_s,
+                                    "lines": list(exc.trace_lines)})
+        if isinstance(exc, SDCDetectedError):
+            return Signal("sdc_persistent", step=exc.step,
+                          evidence={"leaves": list(exc.leaves)})
+        return Signal(type(exc).__name__, evidence={"error": str(exc)})
+
+    # -- the decision ---------------------------------------------------------
+
+    def decide(self, signal: Signal) -> Decision:
+        """Pick the actuator for ``signal`` per the policy table and the
+        hysteresis state, record the firing, and emit the
+        ``autopilot_decision`` event. Pure bookkeeping — the caller applies
+        the actuator (inside :meth:`recovery`)."""
+        with self._state_lock:
+            policy = self.policies.get(signal.kind)
+            if policy is None:
+                # Unknown signal: the safe actuator, single-rung.
+                policy = Policy(signal.kind, (("checkpoint_halt", None),))
+            now = self._clock()
+            key = (signal.kind, signal.suspect_host)
+            hist = self._fires.setdefault(key, [])
+            hist[:] = [t for t in hist if now - t <= policy.window_s]
+            rung = min(len(hist), len(policy.ladder) - 1)
+            if (signal.kind == "collective_hang"
+                    and signal.suspect_host in self._flagged
+                    and rung == 0 and len(policy.ladder) > 1):
+                # The observatory already measured this host slow: skip the
+                # same-mesh retry rung, go straight to shrinking away.
+                rung = 1
+            hist.append(now)
+            actuator, mode = policy.ladder[rung]
+            decision = Decision(
+                id=0, signal=signal, actuator=actuator,
+                mode=mode, rung=rung, fires_in_window=len(hist),
+                window_s=policy.window_s,
+                backoff_s=policy.backoff_s * (2 ** rung) if policy.backoff_s else 0.0,
+            )
+        return self._record(decision)
+
+    def _record(self, decision: Decision) -> Decision:
+        """The one writer of decision records: id assignment, the
+        ``autopilot_decision`` event, and the actuator metric — shared by
+        :meth:`decide` and the non-fault regrow path so the event shape
+        cannot diverge between producers."""
+        with self._state_lock:
+            decision.id = len(self.decisions) + 1
+            self.decisions.append(decision)
+        if obsm.enabled():
+            obsm.AUTOPILOT_DECISIONS.inc(actuator=decision.actuator)
+        extra = {"mode": decision.mode} if decision.mode else {}
+        obs_events.emit_event(
+            "autopilot_decision",
+            decision_id=decision.id,
+            signal=decision.signal.kind,
+            actuator=decision.actuator,
+            step=decision.signal.step,
+            suspect_host=decision.signal.suspect_host,
+            rung=decision.rung,
+            fires_in_window=decision.fires_in_window,
+            window_s=decision.window_s,
+            evidence=decision.signal.evidence or None,
+            **extra,
+        )
+        return decision
+
+    # -- serialized application -----------------------------------------------
+
+    @contextlib.contextmanager
+    def recovery(self, decision: Decision):
+        """Critical section for applying ``decision``'s actuator: one
+        recovery at a time across threads (reentrant within one thread, so
+        a recovery that triggers a nested fault handles it as one chain).
+        Sleeps the decision's hysteresis backoff before yielding and records
+        the (start, end, decision_id) interval for the serialization
+        assertions."""
+        me = threading.get_ident()
+        if self._owner is not None and self._owner != me:
+            with self._state_lock:
+                self._serialized_waits += 1
+        self._serial.acquire()
+        try:
+            self._owner = me
+            self._depth += 1
+            self._active_decision_id = decision.id
+            if decision.backoff_s:
+                time.sleep(decision.backoff_s)
+            t0 = self._clock()
+            try:
+                yield
+            finally:
+                self.recovery_intervals.append((t0, self._clock(), decision.id))
+        finally:
+            self._depth -= 1
+            if self._depth == 0:
+                self._owner = None
+                self._active_decision_id = None
+            self._serial.release()
+
+    def stats(self) -> dict:
+        """Decision/recovery accounting for reports and tests."""
+        by_actuator: dict[str, int] = {}
+        for d in self.decisions:
+            by_actuator[d.actuator] = by_actuator.get(d.actuator, 0) + 1
+        return {
+            "decisions": len(self.decisions),
+            "by_actuator": by_actuator,
+            "recoveries": len(self.recovery_intervals),
+            "serialized_waits": self._serialized_waits,
+            "flagged_stragglers": sorted(self._flagged, key=str),
+        }
+
+    # -- installation ---------------------------------------------------------
+
+    @contextlib.contextmanager
+    def installed(self):
+        """Make this the process's active autopilot within the scope — the
+        de-opt ladder and the SDC guard consult :func:`current`."""
+        tok = _current.set(self)
+        try:
+            yield self
+        finally:
+            _current.reset(tok)
+
+
+_current: contextvars.ContextVar[Optional[Autopilot]] = contextvars.ContextVar(
+    "thunder_tpu_autopilot", default=None
+)
+
+
+def current() -> Optional[Autopilot]:
+    """The installed autopilot, or None — seams that cannot take a
+    parameter (deopt.escalate, the SDC guard) ask here before deciding."""
+    return _current.get()
+
+
+def install(ap: Optional[Autopilot]):
+    """Process-wide installation (None uninstalls); prefer the scoped
+    :meth:`Autopilot.installed` where a ``with`` block fits."""
+    _current.set(ap)
+    return ap
+
+
+# =============================================================================
+# Mesh reshaping helpers
+# =============================================================================
+
+
+def shrink_shape(shape: dict, order=("fsdp", "tp", "dp")) -> Optional[dict]:
+    """Halve the first axis in ``order`` (then any axis) still > 1 —
+    "half the machines survived" as a shape transform. None when the mesh
+    is already a single device (nothing left to shrink onto)."""
+    axes = [a for a in order if shape.get(a, 1) > 1]
+    axes += [a for a in shape if a not in order and shape[a] > 1]
+    if not axes:
+        return None
+    out = dict(shape)
+    out[axes[0]] = out[axes[0]] // 2
+    return out
+
+
+def _make_mesh(shape: dict):
+    from thunder_tpu.parallel import make_mesh
+
+    return make_mesh(**{k: int(v) for k, v in shape.items()})
+
+
+# =============================================================================
+# The autopiloted training driver
+# =============================================================================
+
+
+@dataclass
+class AutopilotReport:
+    """What :func:`run_autopiloted_training` hands back besides the state."""
+
+    losses: list = field(default_factory=list)
+    decisions: list = field(default_factory=list)
+    final_mesh_shape: Optional[dict] = None
+    recoveries: int = 0
+    halted: Optional[AutopilotHalt] = None
+    steps_executed: int = 0  # includes re-executed (wasted) steps
+
+
+def run_autopiloted_training(
+    autopilot: Autopilot,
+    build_for_mesh: Callable,
+    init_state: Any,
+    n_steps: int,
+    *,
+    manager,
+    mesh,
+    specs_for_mesh: Callable,
+    sdc_guard=True,
+    watchdog_timeout_s: Optional[float] = None,
+    save_every: int = 0,
+    on_step: Optional[Callable] = None,
+    regrow_after: Optional[int] = None,
+    max_recoveries: int = 32,
+    warm_start: bool = True,
+) -> tuple[Any, AutopilotReport]:
+    """Drive training to ``n_steps`` under the autopilot: faults raised out
+    of :func:`~.preemption.run_training` are normalized into signals, the
+    policy engine picks the actuator, and this loop applies it — elastic
+    resume (same mesh / shrunk mesh / regrow), or checkpoint-and-halt
+    (:class:`AutopilotHalt`). The quarantine-rerun and de-opt actuators fire
+    *inside* the step via the installed-autopilot hooks and need no action
+    here.
+
+    ``build_for_mesh(mesh) -> step_fn`` (``step_fn(state) -> (state, loss)``,
+    non-donating when ``sdc_guard`` is on) and ``specs_for_mesh(mesh) ->
+    PartitionSpec pytree`` rebuild the workload for whatever mesh survives.
+    ``regrow_after`` N healthy post-shrink steps reshard back up to the
+    original mesh ("the replacement host arrived"). An anchor checkpoint is
+    written up front so the first recovery always has something to resume
+    from. Returns ``(state, AutopilotReport)``; losses are indexed by step
+    (re-executed steps overwrite, so each step counts once)."""
+    from thunder_tpu.resilience import elastic
+    from thunder_tpu.resilience.preemption import (
+        HostLost,
+        Preempted,
+        run_training,
+    )
+    from thunder_tpu.resilience.watchdog import (
+        CollectiveTimeoutError,
+        SDCDetectedError,
+    )
+    from thunder_tpu import api
+
+    full_shape = elastic.mesh_shape(mesh)
+    cur_mesh = mesh
+    cur_shape = dict(full_shape or {})
+    state = init_state
+    report = AutopilotReport(losses=[None] * n_steps, final_mesh_shape=cur_shape)
+    shrunk_at: Optional[int] = None  # step the mesh last shrank at
+
+    if manager.latest_complete_step() is None:
+        # Recovery anchor: elastic_resume (the recovery event every
+        # elastic decision must be followed by) needs a checkpoint on disk.
+        manager.save(state, 0, rng_seed=api._global_rng["seed"], mesh=cur_mesh)
+    # The driver owns every restore: elastic_resume reshards the restored
+    # leaves onto the current mesh (a checkpoint restore hands back
+    # single-device arrays that a mesh-sharded step must not be fed), so
+    # run_training always gets start_step and never resumes on its own.
+    state, start = elastic.elastic_resume(
+        manager, state, mesh=cur_mesh, specs=specs_for_mesh(cur_mesh)
+    )
+
+    def _elastic(decision: Decision, target_mesh, target_shape):
+        nonlocal state, start, cur_mesh, cur_shape
+        with autopilot.recovery(decision):
+            state, start = elastic.elastic_resume(
+                manager, state, mesh=target_mesh,
+                specs=specs_for_mesh(target_mesh),
+            )
+            cur_mesh, cur_shape = target_mesh, dict(target_shape)
+            report.recoveries += 1
+            report.final_mesh_shape = cur_shape
+
+    def _on_loss(step, loss):
+        report.losses[step] = loss
+        report.steps_executed += 1
+        if on_step is not None:
+            on_step(step, loss)
+
+    warmed: set = set()
+
+    with autopilot.installed():
+        while True:
+            step_fn = build_for_mesh(cur_mesh)
+            shape_key = tuple(sorted(cur_shape.items()))
+            if warm_start and shape_key not in warmed:
+                # One discarded step OUTSIDE the watchdog: the first call on
+                # a freshly-built mesh step pays the XLA compile, and a cold
+                # compile inside the guarded dispatch reads as a hang —
+                # which would climb the collective_hang ladder on a
+                # perfectly healthy mesh.
+                step_fn(state)
+                warmed.add(shape_key)
+            # After a shrink, run only up to the regrow boundary so the
+            # driver gets the state back at a step edge and can reshard up.
+            target = n_steps
+            if regrow_after and shrunk_at is not None and cur_shape != full_shape:
+                target = min(n_steps, (start or 0) + regrow_after)
+            try:
+                state, _ = run_training(
+                    step_fn, state, target,
+                    manager=manager, mesh=cur_mesh, sdc_guard=sdc_guard,
+                    watchdog_timeout_s=watchdog_timeout_s,
+                    save_every=save_every, on_loss=_on_loss,
+                    start_step=start,
+                )
+                if target >= n_steps:
+                    report.decisions = list(autopilot.decisions)
+                    return state, report
+                # Healthy through the regrow window: checkpoint at the
+                # boundary and reshard back up to the full mesh.
+                manager.save(state, target, rng_seed=api._global_rng["seed"],
+                             mesh=cur_mesh)
+                decision = _decide_regrow(autopilot, target, regrow_after)
+                _elastic(decision, _make_mesh(full_shape), full_shape)
+                shrunk_at = None
+                continue
+            except Preempted as e:
+                # The checkpoint_halt decision was emitted inside
+                # run_training before the save; this process stops here.
+                report.decisions = list(autopilot.decisions)
+                report.halted = AutopilotHalt(e.step, "preemption", None)
+                report.halted.report = report
+                raise report.halted from e
+            except (HostLost, CollectiveTimeoutError, SDCDetectedError) as e:
+                if report.recoveries >= max_recoveries:
+                    raise
+                signal = autopilot.signal_from_exception(e)
+                decision = autopilot.decide(signal)
+                if decision.actuator == "checkpoint_halt":
+                    with autopilot.recovery(decision):
+                        path = manager.save(
+                            state, start if start is not None else 0,
+                            rng_seed=api._global_rng["seed"], mesh=cur_mesh,
+                        )
+                    report.decisions = list(autopilot.decisions)
+                    report.halted = AutopilotHalt(
+                        signal.step or 0, f"policy ladder exhausted for "
+                        f"{signal.kind}", decision)
+                    report.halted.report = report
+                    raise report.halted from e
+                if decision.mode == "shrink":
+                    new_shape = shrink_shape(cur_shape)
+                    if new_shape is None:
+                        # Nothing left to shrink onto: halt instead.
+                        with autopilot.recovery(decision):
+                            manager.save(state, start or 0,
+                                         rng_seed=api._global_rng["seed"],
+                                         mesh=cur_mesh)
+                        report.decisions = list(autopilot.decisions)
+                        report.halted = AutopilotHalt(
+                            signal.step or 0, "mesh exhausted", decision)
+                        report.halted.report = report
+                        raise report.halted from e
+                    _elastic(decision, _make_mesh(new_shape), new_shape)
+                    shrunk_at = start
+                else:  # same_mesh
+                    _elastic(decision, cur_mesh, cur_shape)
+                continue
+
+
+def _decide_regrow(autopilot: Autopilot, step: int, healthy: Optional[int]) -> Decision:
+    """The regrow decision: not fault-triggered, so it bypasses the policy
+    ladder — a healthy window elapsed and replacement capacity is assumed
+    back (the soak's stand-in for a scheduler granting a new host)."""
+    return autopilot._record(Decision(
+        id=0,
+        signal=Signal("host_recovered", step=step,
+                      evidence={"healthy_steps": healthy}),
+        actuator="elastic_resume", mode="regrow",
+    ))

@@ -166,33 +166,52 @@ def escalate(cd, reason: str, attempt: int, *, entry=None, cs=None) -> bool:
             level, predicted, skipped = _choose_level(peaks, capacity, base)
     if level > MAX_LEVEL or attempt >= max_attempts():
         return False
-    cd._deopt_level = level
-    backoff = _backoff_s(attempt)
-    if obsm.enabled():
-        obsm.COMPILE_DEOPTS.inc(level=str(level))
-    # Planner fields appear ONLY on planner-guided escalations (a level was
-    # priced or proven-skipped) — consumers detect guidance by field
-    # presence, so blind climbs must not emit nulls or a lone capacity.
-    planner = {}
-    if predicted is not None or skipped:
-        planner = {
-            k: v
-            for k, v in (("predicted_peak_bytes", predicted),
-                         ("capacity_bytes", capacity),
-                         ("skipped_levels", skipped or None))
-            if v is not None
-        }
-    obs_events.emit_event(
-        "compile_deopt",
-        level=level,
-        action=_LEVEL_ACTIONS.get(level, "?"),
-        reason=reason,
-        attempt=attempt,
-        backoff_s=backoff,
-        **planner,
-    )
-    if backoff:
-        time.sleep(backoff)
+    # With an autopilot installed (ISSUE 11), the climb is a policy
+    # decision: the typed autopilot_decision (actuator deopt_escalate)
+    # precedes the compile_deopt recovery event it correlates with, and
+    # the escalation applies inside the serialized-recovery critical
+    # section — a sidecar thread's de-opt cannot interleave with an
+    # elastic resume in flight.
+    import contextlib
+
+    from thunder_tpu.resilience import autopilot as ap_mod
+
+    ap = ap_mod.current()
+    ctx = contextlib.nullcontext()
+    if ap is not None:
+        decision = ap.decide(ap_mod.Signal(
+            "oom" if "oom" in reason else "compile_fail",
+            evidence={"reason": reason, "level": level, "attempt": attempt},
+        ))
+        ctx = ap.recovery(decision)
+    with ctx:
+        cd._deopt_level = level
+        backoff = _backoff_s(attempt)
+        if obsm.enabled():
+            obsm.COMPILE_DEOPTS.inc(level=str(level))
+        # Planner fields appear ONLY on planner-guided escalations (a level
+        # was priced or proven-skipped) — consumers detect guidance by field
+        # presence, so blind climbs must not emit nulls or a lone capacity.
+        planner = {}
+        if predicted is not None or skipped:
+            planner = {
+                k: v
+                for k, v in (("predicted_peak_bytes", predicted),
+                             ("capacity_bytes", capacity),
+                             ("skipped_levels", skipped or None))
+                if v is not None
+            }
+        obs_events.emit_event(
+            "compile_deopt",
+            level=level,
+            action=_LEVEL_ACTIONS.get(level, "?"),
+            reason=reason,
+            attempt=attempt,
+            backoff_s=backoff,
+            **planner,
+        )
+        if backoff:
+            time.sleep(backoff)
     return True
 
 

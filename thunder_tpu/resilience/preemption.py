@@ -347,10 +347,36 @@ class CheckpointManager:
 
         return dckpt.load(os.path.join(step_dir, "state"))
 
+    def _quarantined_on_disk(self) -> list[str]:
+        """Quarantined checkpoint dirs (``step_*.corrupt`` /
+        ``step_*.corrupt.N``), oldest first by mtime."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            if name.startswith("step_") and ".corrupt" in name:
+                path = os.path.join(self.directory, name)
+                try:
+                    out.append((os.path.getmtime(path), path))
+                except OSError:
+                    continue
+        return [p for _, p in sorted(out)]
+
     def _gc(self) -> None:
         steps = [s for s in self.steps_on_disk() if self._is_complete(s)]
         for s in steps[:-self.keep] if self.keep > 0 else []:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        # Quarantined (.corrupt/.corrupt.N) dirs fold into the same bounded
+        # retention: repeated corruption under a long soak previously grew
+        # the directory without limit because the sweep only ever looked at
+        # committed steps (ISSUE 11 satellite). Newest `keep` quarantines
+        # stay for post-mortem; older ones go. Primary-only, like the rest
+        # of the sweep (PR 9 commit discipline).
+        if self.keep > 0:
+            for path in self._quarantined_on_disk()[:-self.keep]:
+                shutil.rmtree(path, ignore_errors=True)
 
     # -- restore --------------------------------------------------------------
 
@@ -428,6 +454,7 @@ def run_training(
     mesh=None,
     sdc_guard=None,
     watchdog_timeout_s: Optional[float] = None,
+    start_step: Optional[int] = None,
 ) -> tuple[Any, list]:
     """Drive ``step_fn(state) -> (state, loss)`` for ``n_steps`` with
     preemption-safe checkpointing.
@@ -453,8 +480,19 @@ def run_training(
     - ``watchdog_timeout_s`` (or ``THUNDER_TPU_COLLECTIVE_TIMEOUT_S``)
       runs each step under the collective watchdog, turning a hung
       collective into a typed
-      :class:`~thunder_tpu.resilience.watchdog.CollectiveTimeoutError`."""
+      :class:`~thunder_tpu.resilience.watchdog.CollectiveTimeoutError`;
+    - ``start_step`` skips the internal :func:`resume` and starts the loop
+      there with ``state`` as passed — the spelling
+      :func:`~thunder_tpu.resilience.autopilot.run_autopiloted_training`
+      uses after it has already restored (and possibly resharded) the
+      state itself.
+
+    With an autopilot installed (:func:`~thunder_tpu.resilience.autopilot.
+    current`), the preemption branch and the SDC quarantine path route
+    their choices through it first, so every recovery carries a typed
+    ``autopilot_decision`` event (ISSUE 11)."""
     from thunder_tpu import api
+    from thunder_tpu.resilience import autopilot as ap_mod
     from thunder_tpu.resilience import watchdog as wd
 
     sdc = wd.resolve_sdc_guard(sdc_guard)
@@ -484,12 +522,27 @@ def run_training(
         return step_fn(s)
 
     try:
-        state, start = resume(manager, state)
+        if start_step is not None:
+            start = int(start_step)
+        else:
+            state, start = resume(manager, state)
         for step in range(start, n_steps):
             if guard.should_checkpoint(step):
-                path = manager.save(
-                    state, step, rng_seed=api._global_rng["seed"], mesh=mesh
-                )
+                import contextlib
+
+                ap = ap_mod.current()
+                ctx = contextlib.nullcontext()
+                if ap is not None:
+                    # The decision precedes its recovery event (the ok
+                    # checkpoint_save below) so the replay correlation
+                    # rule can pair them; the save — the actuator — runs
+                    # inside the serialized-recovery critical section.
+                    decision = ap.decide(ap_mod.Signal("preempt", step=step))
+                    ctx = ap.recovery(decision)
+                with ctx:
+                    path = manager.save(
+                        state, step, rng_seed=api._global_rng["seed"], mesh=mesh
+                    )
                 raise Preempted(step, path)
             # Host-loss agreement runs through the same any-host collective
             # as preemption: a host-targeted injection (host_loss@N,host=1)
@@ -554,20 +607,38 @@ def _sdc_check_and_rerun(sdc, run_step, prev_state, state, loss, step):
         "sdc_suspect", step=int(step), leaves=leaves,
         devices=wd.suspect_devices(divergence), detail=divergence or None,
     )
-    for attempt in range(sdc.max_reruns):
-        state, loss = run_step(prev_state)
-        if chaos.enabled():
-            # A truly bad device corrupts the re-run too: the chaos seam
-            # stays in the path so persistent (count>1) SDC rules exercise
-            # the rerun-exhausted → SDCDetectedError ladder.
-            state = chaos.maybe_corrupt_replica(state)
-        divergence = sdc.check_state(state)
-        ok = not divergence
-        if obsm.enabled():
-            obsm.SDC_RERUNS.inc(ok=str(ok).lower())
-        obs_events.emit_event(
-            "sdc_rerun", step=int(step), ok=ok, attempt=attempt
-        )
-        if ok:
-            return state, loss
+    # With an autopilot installed, the quarantine+rerun is a DECISION, not
+    # just a reflex: the typed autopilot_decision event precedes the rerun
+    # and the rerun runs inside the serialized-recovery critical section,
+    # so an overlapping fault's actuator cannot interleave with it.
+    import contextlib
+
+    from thunder_tpu.resilience import autopilot as ap_mod
+
+    ap = ap_mod.current()
+    ctx = contextlib.nullcontext()
+    if ap is not None:
+        decision = ap.decide(ap_mod.Signal(
+            "sdc_suspect", step=int(step),
+            evidence={"leaves": leaves,
+                      "devices": wd.suspect_devices(divergence)},
+        ))
+        ctx = ap.recovery(decision)
+    with ctx:
+        for attempt in range(sdc.max_reruns):
+            state, loss = run_step(prev_state)
+            if chaos.enabled():
+                # A truly bad device corrupts the re-run too: the chaos seam
+                # stays in the path so persistent (count>1) SDC rules
+                # exercise the rerun-exhausted → SDCDetectedError ladder.
+                state = chaos.maybe_corrupt_replica(state)
+            divergence = sdc.check_state(state)
+            ok = not divergence
+            if obsm.enabled():
+                obsm.SDC_RERUNS.inc(ok=str(ok).lower())
+            obs_events.emit_event(
+                "sdc_rerun", step=int(step), ok=ok, attempt=attempt
+            )
+            if ok:
+                return state, loss
     raise SDCDetectedError(step, sorted(divergence))

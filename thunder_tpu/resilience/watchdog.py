@@ -109,6 +109,30 @@ class SDCDetectedError(RuntimeError):
 
 _config: dict = {"timeout_s": None, "resolved": False}
 _last_health: dict = {"summary": None}
+# Workers abandoned after a timeout (a hung collective cannot be cancelled,
+# so the thread leaks until the hang clears). A soak full of injected hangs
+# would otherwise grow live threads without bound (ISSUE 11 satellite):
+# past THUNDER_TPU_WATCHDOG_MAX_ABANDONED live abandoned workers, guard
+# arming is refused — the dispatch runs unguarded with a warning — until
+# some of them die. The registry lock keeps a concurrent timeout's append
+# from being lost under another thread's prune (guard_call is explicitly
+# multi-thread safe).
+_abandoned: list = []
+_abandoned_lock = threading.Lock()
+
+
+def max_abandoned_workers() -> int:
+    try:
+        return int(os.environ.get("THUNDER_TPU_WATCHDOG_MAX_ABANDONED", "16"))
+    except ValueError:
+        return 16
+
+
+def abandoned_worker_count() -> int:
+    """Live abandoned watchdog workers (dead ones are pruned on each call)."""
+    with _abandoned_lock:
+        _abandoned[:] = [t for t in _abandoned if t.is_alive()]
+        return len(_abandoned)
 
 
 def configure(timeout_s: Optional[float]) -> None:
@@ -175,6 +199,23 @@ def guard_call(
     timeout = timeout_s if timeout_s is not None else active_timeout()
     if timeout is None:
         return fn(*args, **(kwargs or {}))
+    cap = max_abandoned_workers()
+    if abandoned_worker_count() >= cap:
+        # Refusing to arm bounds the leak: each timeout strands one worker
+        # thread forever (the hung collective cannot be cancelled), and a
+        # soak full of hangs must not grow threads without limit. The
+        # dispatch still runs — unguarded, loudly.
+        import warnings
+
+        if obsm.enabled():
+            obsm.WATCHDOG_UNGUARDED.inc()
+        warnings.warn(
+            f"thunder_tpu collective watchdog: {cap} abandoned worker(s) "
+            f"still alive (THUNDER_TPU_WATCHDOG_MAX_ABANDONED={cap}); "
+            f"running {fn_name!r} UNguarded until they exit",
+            RuntimeWarning, stacklevel=2,
+        )
+        return fn(*args, **(kwargs or {}))
 
     import contextvars
 
@@ -200,6 +241,8 @@ def guard_call(
     t.start()
     t.join(timeout)
     if t.is_alive():
+        with _abandoned_lock:
+            _abandoned.append(t)
         lines = list(trace_lines or [])
         suspect = _suspected_host()
         if obsm.enabled():
