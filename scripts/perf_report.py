@@ -126,6 +126,17 @@ def metric_direction(name: str, series: str = "") -> Optional[int]:
     return None
 
 
+def mfu_comparable(name: str, *rounds: dict) -> bool:
+    """MFU against the ``cpu`` fallback spec is meaningless (the "peak
+    FLOP/s" is a made-up host number — MULTICHIP_BENCH r02's 0.001) and
+    would trip direction-aware gating the first time it wiggles: an MFU
+    metric is only gated when every round that reports it ran on a real
+    device spec."""
+    if "mfu" not in name.lower():
+        return True
+    return all(m.get("_device_spec") != "cpu" for m in rounds)
+
+
 def noise_floor(name: str, series: str = "") -> float:
     """Minimum absolute delta for ``name`` to gate; ``series`` is the
     round's headline ``metric`` name, selecting the multichip/soak floor
@@ -170,6 +181,8 @@ def load_round(path: str) -> tuple[str, dict[str, float]]:
     }
     if isinstance(metrics.get("metric"), str):
         out["_metric_name"] = metrics["metric"]  # type: ignore[assignment]
+    if isinstance(metrics.get("device_spec"), str):
+        out["_device_spec"] = metrics["device_spec"]  # type: ignore[assignment]
     return label, out
 
 
@@ -229,6 +242,8 @@ def analyze_history(
                 continue
             if name in _HEADLINE_KEYS and not same_headline:
                 continue  # the rounds benched different headline workloads
+            if not mfu_comparable(name, m0, m1):
+                continue  # cpu-fallback MFU is not a real utilization number
             prev, cur = m0[name], m1[name]
             if prev == 0:
                 continue
@@ -261,6 +276,8 @@ def compare_rounds(
             continue
         if name in _HEADLINE_KEYS and not same_headline:
             continue
+        if not mfu_comparable(name, prev, cur):
+            continue
         p, c = prev[name], cur[name]
         if not isinstance(p, (int, float)) or not isinstance(c, (int, float)) or p == 0:
             continue
@@ -288,7 +305,9 @@ def format_history(rounds: list[tuple[str, dict[str, float]]],
             v = m.get(n)
             cells.append(f"{v:>10.4g}" if v is not None else f"{'-':>10}")
         arrow = {1: "^", -1: "v"}[metric_direction(n, series)]
-        lines.append(f"  {n:<{w}} " + " ".join(cells) + f"  [{arrow}]")
+        note = "" if mfu_comparable(n, *[m for _, m in rounds]) else \
+            " (cpu spec: not comparable, not gated)"
+        lines.append(f"  {n:<{w}} " + " ".join(cells) + f"  [{arrow}]{note}")
     if regressions:
         lines.append("")
         for r in regressions:

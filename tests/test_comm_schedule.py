@@ -1,0 +1,445 @@
+"""Certificate-driven collective-overlap scheduler tests (ISSUE 13):
+predict_overlap window/budget goldens, scheduler hoists + pins +
+recertify round-trips, seeded-bad placement rejection, liveness back-off
+under a capacity squeeze, the sched.exposed-collective advisory rule, ICI
+calibration, chaos sched_bad fallback, and numeric equivalence of the
+scheduled program on the virtual mesh."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import thunder_tpu.clang as clang
+import thunder_tpu.core.prims as prims
+from thunder_tpu.analysis import Severity, verify
+from thunder_tpu.analysis import schedule as sched_mod
+from thunder_tpu.analysis.cost import (
+    DEVICE_SPECS,
+    calibrate_ici,
+    resolve_device_spec,
+    trace_cost,
+)
+from thunder_tpu.analysis.liveness import plan_liveness
+from thunder_tpu.api import trace_program
+from thunder_tpu.core import devices, dtypes
+from thunder_tpu.core.proxies import TensorProxy
+from thunder_tpu.core.trace import TraceCtx, tracectx
+from thunder_tpu.distributed import prims as dist_prims
+from thunder_tpu.executors.passes import del_last_used, transform_for_execution
+from thunder_tpu.extend import resolve_executors
+from thunder_tpu.resilience import chaos as chaos_mod
+from thunder_tpu.transforms.autodiff import grad_transform
+from thunder_tpu.transforms.common import dce
+from thunder_tpu.transforms.comm_schedule import (
+    PlacementError,
+    apply_placement,
+    enabled,
+    schedule_collectives,
+)
+
+
+def _cpu():
+    return devices.Device("cpu")
+
+
+def _t(shape=(64, 64), name=None):
+    return TensorProxy(name=name, shape=shape, dtype=dtypes.float32, device=_cpu())
+
+
+def _mlp_extrace(layers=3, d=64, B=16, fsdp=4, tp=2, grad=True):
+    """The fsdp×tp explicit-collective MLP fw(+bw) claimed trace — the
+    bench/smoke workload shape."""
+    rng = np.random.RandomState(0)
+    ws = [rng.randn(d // fsdp, d).astype(np.float32) for _ in range(layers)]
+    x = rng.randn(B, d).astype(np.float32)
+
+    def loss(*flat_in):
+        *w_shards, xv = flat_in
+        h = xv
+        for w_shard in w_shards:
+            w_full = dist_prims.synchronize(w_shard, "fsdp", fsdp, "fsdp")
+            h = clang.matmul(h, clang.transpose(w_full, 0, 1))
+            h = dist_prims.all_reduce(h, "tp", tp, op="avg")
+            h = clang.tanh(h)
+        return clang.mean(clang.mul(h, h))
+
+    _, comp = trace_program(loss, (*ws, x), {})
+    comp = dce(comp)
+    if grad:
+        comp = grad_transform(comp, return_value=True)
+    return transform_for_execution(comp, resolve_executors(["jax"]))
+
+
+class TestPredictOverlap:
+    def _gather_then_compute(self):
+        """gather (wire) -> independent matmul -> consumer of the gather."""
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t((16, 64))
+            b = _t((64, 64))
+            trc.args = (a, b)
+            g = dist_prims.all_gather(a, "dp", 4, dim=0)
+            c = clang.matmul(b, b)          # independent of g: in g's window
+            out = clang.matmul(c, clang.transpose(g, 0, 1))
+            prims.python_return(out)
+            trc.output = out
+        return trc
+
+    def test_window_is_independent_compute(self):
+        pred = sched_mod.predict_overlap(self._gather_then_compute(), device="v5e")
+        site = pred.sites[0]
+        assert site.sym == "all_gather"
+        assert site.first_consumer == 2  # the consuming matmul
+        assert site.window_us > 0
+        assert site.hidden_us == pytest.approx(min(site.wire_us, site.window_us))
+
+    def test_hidden_capped_by_wire(self):
+        pred = sched_mod.predict_overlap(self._gather_then_compute(), device="v5e")
+        for s in pred.sites:
+            assert s.hidden_us <= s.wire_us + 1e-9
+            assert s.exposed_us == pytest.approx(s.wire_us - s.hidden_us)
+
+    def test_budget_not_double_counted(self):
+        """Two collectives sharing one window line cannot both claim it."""
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t((16, 64))
+            b = _t((64, 64))
+            trc.args = (a, b)
+            g1 = dist_prims.all_gather(a, "dp", 4, dim=0)
+            g2 = dist_prims.all_gather(a, "tp", 4, dim=0)
+            c = clang.matmul(b, b)  # the one shared window line
+            o1 = clang.matmul(c, clang.transpose(g1, 0, 1))
+            o2 = clang.matmul(o1, clang.transpose(g2, 0, 1))
+            out = clang.add(o2, o2)
+            prims.python_return(out)
+            trc.output = out
+        pred = sched_mod.predict_overlap(trc, device="v5e")
+        s1, s2 = pred.sites[0], pred.sites[1]
+        # The two windows overlap on the shared compute line: whatever the
+        # split, total hidden cannot exceed the compute in the UNION of the
+        # two windows (lines between site 0/1 and their first consumers).
+        union = range(2, max(s1.first_consumer, s2.first_consumer))
+        union_budget = sum(
+            r.roofline_s * 1e6
+            for r in trace_cost(trc, "v5e").rows
+            if r.index in union and r.kind != "collective"
+        )
+        assert s1.hidden_us + s2.hidden_us <= union_budget + 1e-6
+        # The first site drains the shared line entirely (its window is only
+        # that line and smaller than its wire), so the second site's hidden
+        # comes from the rest of its window alone.
+        shared_us = next(
+            r.roofline_s * 1e6 for r in trace_cost(trc, "v5e").rows
+            if r.index == 2
+        )
+        assert s1.hidden_us == pytest.approx(shared_us)
+        assert s2.hidden_us <= s2.window_us - shared_us + 1e-6
+
+    def test_exposed_pct_totals(self):
+        pred = sched_mod.predict_overlap(_mlp_extrace(), device="cpu")
+        assert 0.0 <= pred.exposed_pct <= 100.0
+        assert pred.exposed_us == pytest.approx(pred.wire_us - pred.hidden_us)
+
+
+class TestScheduler:
+    def test_hoists_prefetchable_synchronize(self):
+        extrace = _mlp_extrace()
+        pred0 = sched_mod.predict_overlap(extrace, device="cpu")
+        scheduled, rep = schedule_collectives(extrace, device="cpu")
+        assert rep is not None and rep.moves >= 1
+        pred1 = sched_mod.predict_overlap(scheduled, device="cpu")
+        assert pred1.hidden_us > pred0.hidden_us
+        assert pred1.exposed_pct < pred0.exposed_pct
+        moved = [s for s in rep.sites if s.moved]
+        assert any(s.sym == "synchronize" for s in moved)
+        for s in moved:
+            assert s.index_after < s.index_before  # this pass only hoists
+
+    def test_first_gather_is_pinned(self):
+        extrace = _mlp_extrace()
+        scheduled, rep = schedule_collectives(extrace, device="cpu")
+        first = min(rep.sites, key=lambda s: s.index_before)
+        assert first.sym == "synchronize"
+        assert not first.moved
+
+    def test_recertifies_with_identical_axis_order(self):
+        extrace = _mlp_extrace()
+        cert0 = sched_mod.stamp(extrace)
+        scheduled, rep = schedule_collectives(extrace, device="cpu")
+        assert rep.moves >= 1
+        cert1 = sched_mod.certify(scheduled)
+        assert cert1.axis_order == cert0.axis_order
+        # recertify stamped the trace: the verifier accepts the new order.
+        assert scheduled.tags.get("collective_order") == cert1.axis_order
+        assert [d for d in verify(scheduled)
+                if d.severity >= Severity.ERROR] == []
+
+    def test_uncertified_hand_reorder_still_flagged(self):
+        """Scheduling does not weaken the reorder rule: a later pass that
+        hand-swaps two same-axis collectives on the SCHEDULED trace is
+        still an ERROR."""
+        from thunder_tpu.core.trace import from_trace
+
+        scheduled, rep = schedule_collectives(_mlp_extrace(), device="cpu")
+        cert = sched_mod.certify(scheduled)
+        fsdp_sites = [s.index for s in cert.sites if s.axis == "fsdp"]
+        bad = from_trace(scheduled)
+        bs = list(scheduled.bound_symbols)
+        i, j = fsdp_sites[0], fsdp_sites[1]
+        bs[i], bs[j] = bs[j], bs[i]
+        bad.bound_symbols = bs
+        diags = verify(bad, pass_name="evil post-schedule pass")
+        assert any(d.rule == "sched.uncertified-reorder"
+                   and d.severity == Severity.ERROR for d in diags)
+
+    def test_seeded_bad_placement_rejected(self):
+        extrace = _mlp_extrace()
+        cert = sched_mod.certify(extrace)
+        movable = next(s for s in cert.sites if s.sym == "synchronize"
+                       and s.hoistable)
+        with pytest.raises(PlacementError):
+            apply_placement(extrace, movable.key, movable.latest + 3)
+        with pytest.raises(PlacementError):
+            apply_placement(extrace, movable.key, movable.earliest - 1)
+        with pytest.raises(PlacementError):
+            apply_placement(extrace, "no_such_site[xx]->t0", 0)
+
+    def test_legal_placement_applies_and_recertifies(self):
+        extrace = _mlp_extrace()
+        cert = sched_mod.certify(extrace)
+        movable = next(s for s in cert.sites if s.sym == "synchronize"
+                       and s.hoistable)
+        moved = apply_placement(extrace, movable.key, movable.earliest)
+        cert2 = sched_mod.certify(moved)
+        assert cert2.axis_order == cert.axis_order
+        assert [d for d in verify(moved)
+                if d.severity >= Severity.ERROR] == []
+
+    def test_liveness_backoff_under_capacity(self):
+        fwd = _mlp_extrace(grad=False)
+        free, _ = schedule_collectives(fwd, device="cpu")
+        p0 = plan_liveness(fwd, include_rows=False).peak_bytes
+        p1 = plan_liveness(free, include_rows=False).peak_bytes
+        assert p1 > p0  # hoisted gathers materialize full weights early
+        cap = (p0 + p1) // 2
+        capped, rep = schedule_collectives(
+            _mlp_extrace(grad=False), device="cpu", capacity_bytes=cap
+        )
+        assert rep.backoffs >= 1
+        assert plan_liveness(capped, include_rows=False).peak_bytes <= cap
+        assert rep.capacity_bytes == cap
+
+    def test_no_collectives_is_identity(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            out = clang.mul(a, a)
+            prims.python_return(out)
+            trc.output = out
+        new, rep = schedule_collectives(trc)
+        assert new is trc and rep is None
+
+    def test_del_carrying_trace_is_identity(self):
+        extrace = del_last_used(_mlp_extrace())
+        new, rep = schedule_collectives(extrace, device="cpu")
+        assert new is extrace and rep is None
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("THUNDER_TPU_COMM_SCHEDULE", "0")
+        assert not enabled()
+        extrace = _mlp_extrace()
+        new = transform_for_execution(
+            dce(trace_program(lambda x: clang.mul(x, x),
+                              (np.ones((4, 4), np.float32),), {})[1]),
+            resolve_executors(["jax"]), comm_schedule=True,
+        )
+        assert new is not None  # hook path runs without scheduling
+        monkeypatch.setenv("THUNDER_TPU_COMM_SCHEDULE", "1")
+        assert enabled()
+
+    def test_report_tag_is_json_serializable(self):
+        scheduled, rep = schedule_collectives(_mlp_extrace(), device="cpu")
+        tag = scheduled.tags["comm_schedule"]
+        loaded = json.loads(json.dumps(tag))
+        assert loaded["moves"] == rep.moves
+        assert loaded["exposed_pct_after"] <= loaded["exposed_pct_before"]
+        assert len(loaded["sites"]) == len(rep.sites)
+
+    def test_chaos_sched_bad_falls_back(self):
+        extrace = _mlp_extrace()
+        order = sched_mod.certify(extrace).axis_order
+        with chaos_mod.chaos_scope("sched_bad*1"):
+            new, rep = schedule_collectives(extrace, device="cpu")
+        assert new is extrace and rep is None
+        assert sched_mod.certify(new).axis_order == order
+
+
+class TestExposedCollectiveRule:
+    def test_fires_info_on_exposed_site(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t((256, 256))
+            trc.args = (a,)
+            g = dist_prims.all_gather(a, "dp", 8, dim=0)
+            out = clang.mul(g, g)  # immediate consumer: fully exposed
+            prims.python_return(out)
+            trc.output = out
+        diags = [d for d in verify(trc) if d.rule == "sched.exposed-collective"]
+        assert diags and all(d.severity == Severity.INFO for d in diags)
+        assert "exposed" in diags[0].message
+
+    def test_silent_without_collectives(self):
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t()
+            trc.args = (a,)
+            out = clang.mul(a, a)
+            prims.python_return(out)
+            trc.output = out
+        assert [d for d in verify(trc)
+                if d.rule == "sched.exposed-collective"] == []
+
+    def test_advisory_never_gates(self):
+        """INFO diagnostics must not fail verify_or_raise at ERROR."""
+        from thunder_tpu.analysis import verify_or_raise
+
+        trc = TraceCtx()
+        with tracectx(trc):
+            a = _t((256, 256))
+            trc.args = (a,)
+            g = dist_prims.all_gather(a, "dp", 8, dim=0)
+            out = clang.mul(g, g)
+            prims.python_return(out)
+            trc.output = out
+        verify_or_raise(trc)  # must not raise
+
+
+class TestCalibration:
+    def test_fit_and_pricing(self):
+        spec = DEVICE_SPECS["cpu"]
+        # 1 MB all-gather measured at 1 s -> 1 MB/s effective.
+        cal = calibrate_ici(spec, [("all-gather", 1e6, 1.0)])
+        assert cal.ici_bw_for("all-gather") == pytest.approx(1e6)
+        # Unfitted classes fall back to the datasheet rate.
+        assert cal.ici_bw_for("all-reduce") == spec.ici_bw
+        assert cal.ici_bw_for(None) == spec.ici_bw
+        # The base spec is untouched (frozen + replace).
+        assert spec.ici_class_bw is None
+
+    def test_fit_clamped_to_datasheet(self):
+        spec = DEVICE_SPECS["cpu"]
+        cal = calibrate_ici(spec, [("all-reduce", 1e12, 1.0)])  # "faster than wire"
+        assert cal.ici_bw_for("all-reduce") == spec.ici_bw
+
+    def test_empty_or_garbage_samples_are_identity(self):
+        spec = DEVICE_SPECS["cpu"]
+        assert calibrate_ici(spec, []) is spec
+        assert calibrate_ici(spec, [(None, 0, 0), ("x", 1e3, 0.0)]) is spec
+
+    def test_trace_cost_prices_calibrated_wire(self):
+        extrace = _mlp_extrace(grad=False)
+        spec = resolve_device_spec("cpu")
+        slow = calibrate_ici(spec, [("all-gather", 1e6, 1.0)])  # 1 MB/s
+        base_rows = [r for r in trace_cost(extrace, spec).rows
+                     if r.sym == "synchronize"]
+        slow_rows = [r for r in trace_cost(extrace, slow).rows
+                     if r.sym == "synchronize"]
+        assert slow_rows[0].roofline_s > base_rows[0].roofline_s * 100
+
+
+class TestScheduledProgramRuns:
+    def test_scheduled_trace_matches_unscheduled_numerics(self):
+        """The scheduled program computes the same loss on the virtual
+        mesh — scheduling is a pure reorder inside certified intervals."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from thunder_tpu.core.pytree import tree_flatten
+        from thunder_tpu.distributed.runtime import stage_collective_trace
+        from thunder_tpu.parallel import make_mesh
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        layers, d, B, fsdp, tp = 2, 32, 8, 4, 2
+        extrace = _mlp_extrace(layers=layers, d=d, B=B, fsdp=fsdp, tp=tp)
+        scheduled, rep = schedule_collectives(extrace, device="cpu")
+        assert rep is not None and rep.moves >= 1
+
+        mesh = make_mesh(fsdp=fsdp, tp=tp)
+        w_spec = P("fsdp", None)
+        in_specs = tuple([w_spec] * layers + [P()])
+        out_specs = (P(), tuple([w_spec] * layers + [P()]))
+        rng = np.random.RandomState(0)
+        flat = [jnp.asarray(rng.randn(d, d).astype(np.float32))
+                for _ in range(layers)]
+        flat.append(jnp.asarray(rng.randn(B, d).astype(np.float32)))
+
+        jf0 = stage_collective_trace(extrace, mesh, in_specs, out_specs)
+        jf1 = stage_collective_trace(scheduled, mesh, in_specs, out_specs)
+        out0 = tree_flatten(jf0(*flat))[0]
+        out1 = tree_flatten(jf1(*flat))[0]
+        for a, b in zip(out0, out1):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+
+
+class TestPipelineWiring:
+    def test_compile_with_collectives_schedules(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from thunder_tpu.core.pytree import tree_flatten
+        from thunder_tpu.distributed.runtime import compile_with_collectives
+        from thunder_tpu.parallel import make_mesh
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        fsdp, tp, d, B = 4, 2, 32, 8
+        mesh = make_mesh(fsdp=fsdp, tp=tp)
+        rng = np.random.RandomState(0)
+        w1, w2 = (rng.randn(d, d).astype(np.float32) for _ in range(2))
+        x = rng.randn(B, d).astype(np.float32)
+
+        def loss(w1s, w2s, xv):
+            a = dist_prims.synchronize(w1s, "fsdp", fsdp, "fsdp")
+            h = clang.tanh(clang.matmul(xv, clang.transpose(a, 0, 1)))
+            b = dist_prims.synchronize(w2s, "fsdp", fsdp, "fsdp")
+            out = clang.matmul(h, clang.transpose(b, 0, 1))
+            return clang.mean(clang.mul(out, out))
+
+        shards = (w1[: d // fsdp], w2[: d // fsdp], x)
+        specs = (P("fsdp", None), P("fsdp", None), P())
+        jf, extrace = compile_with_collectives(
+            loss, shards, mesh, specs, (P(), specs), grad=True,
+            comm_schedule=True,
+        )
+        tag = extrace.tags.get("comm_schedule")
+        assert tag is not None and tag["moves"] >= 1
+        out = jf(*[jnp.asarray(a) for a in (w1, w2, x)])
+        loss_val = float(np.asarray(tree_flatten(out)[0][0]))
+        assert np.isfinite(loss_val)
+
+    def test_static_planner_schedule_gated_by_deopt(self):
+        """api._static_planner schedules at L0 and skips from L1 up."""
+        from thunder_tpu.api import _static_planner
+
+        ex0 = _mlp_extrace()
+        new0, plan0, cert0 = _static_planner(
+            ex0, None, donate=False, rerun_capable=False, comm_schedule=True
+        )
+        assert new0 is not ex0  # scheduled (moves exist on this workload)
+        assert new0.tags.get("comm_schedule", {}).get("moves", 0) >= 1
+        assert plan0 is not None and cert0 is not None
+
+        ex1 = _mlp_extrace()
+        new1, plan1, cert1 = _static_planner(
+            ex1, None, donate=False, rerun_capable=False, comm_schedule=False
+        )
+        assert new1 is ex1
+        assert "comm_schedule" not in ex1.tags

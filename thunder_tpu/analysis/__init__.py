@@ -44,6 +44,8 @@ from thunder_tpu.analysis.cost import (  # noqa: F401
     OpCost,
     TraceCost,
     bsym_cost,
+    calibrate_ici,
+    collective_sym_class,
     cost_report,
     resolve_device_spec,
     trace_cost,
@@ -59,8 +61,11 @@ from thunder_tpu.analysis.liveness import (  # noqa: F401
 )
 from thunder_tpu.analysis.schedule import (  # noqa: F401
     CollectiveSite,
+    OverlapPrediction,
     ScheduleCertificate,
+    SiteOverlap,
     certify,
+    predict_overlap,
     recertify,
 )
 from thunder_tpu.analysis.registry import (  # noqa: F401

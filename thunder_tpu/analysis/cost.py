@@ -70,9 +70,26 @@ class DeviceSpec:
     # backend's bytes_limit and the THUNDER_TPU_HBM_BYTES override). 0 means
     # unknown: the liveness planner's fit checks are skipped.
     hbm_bytes: float = 0.0
+    # Effective per-collective-family wire bandwidth (bytes/s), fitted from a
+    # measured per-collective table via :func:`calibrate_ici`. Datasheet
+    # ``ici_bw`` is the link rate; real collectives see less (latency,
+    # algorithm inefficiency — ~1000× less on an emulated CPU mesh, where
+    # "wire" time is thread rendezvous). None = uncalibrated: price at the
+    # datasheet rate.
+    ici_class_bw: Optional[dict] = None
 
     def peak_for(self, dtype: Any) -> float:
         return self.peak_flops.get(_dtype_class(dtype), self.peak_flops["bf16"])
+
+    def ici_bw_for(self, cls: Optional[str]) -> float:
+        """Wire bandwidth used to price a collective of HLO family ``cls``
+        (``all-gather``/``all-reduce``/...): the calibrated per-class rate
+        when one was fitted, else the datasheet ``ici_bw``."""
+        if cls and self.ici_class_bw:
+            bw = self.ici_class_bw.get(cls)
+            if bw:
+                return float(bw)
+        return self.ici_bw
 
     def ridge(self, dtype: Any) -> float:
         """Arithmetic intensity (FLOP/byte) at which compute and memory
@@ -106,6 +123,47 @@ DEVICE_SPECS: dict[str, DeviceSpec] = {
     "cpu": DeviceSpec("cpu", {"bf16": 2e11, "f32": 2e11, "int8": 4e11},
                       hbm_bw=5e10, ici_bw=1e10, hbm_bytes=0.0),
 }
+
+
+def collective_sym_class(sym_name: str) -> Optional[str]:
+    """HLO collective family ("all-gather"/"all-reduce"/...) of a trace-level
+    collective symbol name, or None. One authoritative sym→family map,
+    shared with the measured half (observability/attribution.py)."""
+    from thunder_tpu.observability.attribution import COLLECTIVE_SYM_CLASS
+
+    return COLLECTIVE_SYM_CLASS.get(sym_name)
+
+
+def calibrate_ici(spec: DeviceSpec, samples: Sequence[tuple]) -> DeviceSpec:
+    """Fit an effective per-class ICI bandwidth from measured collectives.
+
+    ``samples``: ``(cls, comm_bytes, measured_s)`` rows — the cost model's
+    ring-factor wire bytes for a collective joined with its measured device
+    time (``scripts/bench_multichip.py`` feeds the lane-segmentation table).
+    The fit is the aggregate rate per family, ``Σ bytes / Σ seconds``,
+    clamped to the datasheet ``ici_bw`` from above (a measurement can only
+    reveal the wire to be *slower* than the link rate). Returns a new spec
+    whose :meth:`DeviceSpec.ici_bw_for` prices each family at its fitted
+    rate — the order-of-magnitude correction the comm scheduler's placement
+    decisions need on meshes whose collective cost is rendezvous-dominated
+    (the emulated CPU mesh measures ~1000× the datasheet wire time)."""
+    import dataclasses
+
+    by_cls: dict[str, list[float]] = {}
+    for cls, comm_bytes, measured_s in samples:
+        if not cls or not comm_bytes or not measured_s or measured_s <= 0:
+            continue
+        agg = by_cls.setdefault(str(cls), [0.0, 0.0])
+        agg[0] += float(comm_bytes)
+        agg[1] += float(measured_s)
+    fitted = {
+        cls: min(b / s, spec.ici_bw) if spec.ici_bw else b / s
+        for cls, (b, s) in by_cls.items()
+        if s > 0 and b > 0
+    }
+    if not fitted:
+        return spec
+    return dataclasses.replace(spec, ici_class_bw=fitted)
 
 
 def resolve_device_spec(device: Any = None) -> DeviceSpec:
@@ -500,7 +558,8 @@ def trace_cost(trace: TraceCtx, device: Any = None) -> TraceCost:
         dtype = outs[0].dtype if outs else None
         t_compute = c.flops / dev.peak_for(dtype)
         t_memory = c.bytes_moved / dev.hbm_bw
-        t_comm = c.comm_bytes / dev.ici_bw if dev.ici_bw and c.comm_bytes else 0.0
+        ici_bw = dev.ici_bw_for(collective_sym_class(bsym.sym.name)) if c.comm_bytes else 0.0
+        t_comm = c.comm_bytes / ici_bw if ici_bw and c.comm_bytes else 0.0
         t = max(t_compute, t_memory, t_comm)
         if t == 0.0:
             bound = "free"

@@ -78,6 +78,11 @@ class CollectiveSite:
     latest: int            # last bsym index the site may legally occupy
     deps_before: tuple = ()   # bsym indexes that must precede (data + axis)
     deps_after: tuple = ()    # bsym indexes that must follow
+    # First bsym index that consumes one of the site's outputs (the RETURN
+    # index when only the return reads it): the right end of the overlap
+    # window — compute strictly between the site and this line can hide the
+    # wire transfer (predict_overlap; the comm scheduler maximizes it).
+    first_consumer: Optional[int] = None
 
     @property
     def hoistable(self) -> bool:
@@ -237,6 +242,7 @@ def certify(trace: TraceCtx, *, ctx: Optional[VerifyContext] = None) -> Schedule
 
         latest = max(return_idx - 1, i)
         pinned_out = False
+        consumers: list[int] = []
         for o in bsym.flat_proxy_outs:
             name = getattr(o, "name", None)
             if name is None:
@@ -247,8 +253,10 @@ def certify(trace: TraceCtx, *, ctx: Optional[VerifyContext] = None) -> Schedule
             if first_live is not None:
                 deps_after.add(first_live)
                 latest = min(latest, first_live - 1)
+                consumers.append(first_live)
         if pinned_out:
             latest = min(latest, return_idx - 1)
+            consumers.append(return_idx)
 
         # Anti-dependencies: an in-place write to an operand's buffer pins
         # the site between the mutations it must read between.
@@ -283,6 +291,7 @@ def certify(trace: TraceCtx, *, ctx: Optional[VerifyContext] = None) -> Schedule
             earliest=earliest, latest=max(latest, earliest),
             deps_before=tuple(sorted(deps_before)),
             deps_after=tuple(sorted(deps_after)),
+            first_consumer=min(consumers) if consumers else None,
         ))
 
     cert.axis_order = _axis_key_order(bsyms)
@@ -308,6 +317,147 @@ def recertify(trace: TraceCtx) -> ScheduleCertificate:
     re-derive the certificate and replace the stamped order, so the
     verifier accepts the new schedule as the baseline going forward."""
     return stamp(trace)
+
+
+# =============================================================================
+# Static overlap prediction — the compile-time twin of the measured lane
+# segmentation (observability/attribution.py)
+# =============================================================================
+
+
+@dataclass
+class SiteOverlap:
+    """Predicted wire/hidden/exposed time of one collective site.
+
+    ``wire_us`` prices the site's ring-factor traffic at the device spec's
+    (possibly calibrated) per-family ICI bandwidth; ``window_us`` is the
+    roofline compute time of the non-collective bsyms strictly between the
+    site and its first consumer — the compute a latency-hiding runtime can
+    provably run while the transfer is in flight, because the certificate
+    says nothing in the window depends on the collective's output."""
+
+    index: int
+    sym: str
+    axis: Optional[str]
+    key: str
+    wire_us: float
+    window_us: float
+    hidden_us: float
+    first_consumer: Optional[int] = None
+
+    @property
+    def exposed_us(self) -> float:
+        return max(0.0, self.wire_us - self.hidden_us)
+
+    @property
+    def hidden_frac(self) -> float:
+        return self.hidden_us / self.wire_us if self.wire_us else 0.0
+
+    def label(self) -> str:
+        return f"L{self.index}.{self.sym}"
+
+
+@dataclass
+class OverlapPrediction:
+    """Per-site predicted hidden/exposed wire time over one trace."""
+
+    device: str
+    sites: list = field(default_factory=list)
+    # Per-line compute budget (µs) left after every site consumed its
+    # share — what the comm scheduler's hoist scan must price NEW window
+    # rows at, so two sites never count the same GEMM twice.
+    residual_budget: dict = field(default_factory=dict)
+
+    @property
+    def wire_us(self) -> float:
+        return sum(s.wire_us for s in self.sites)
+
+    @property
+    def hidden_us(self) -> float:
+        return sum(s.hidden_us for s in self.sites)
+
+    @property
+    def exposed_us(self) -> float:
+        return sum(s.exposed_us for s in self.sites)
+
+    @property
+    def exposed_pct(self) -> float:
+        """Exposed fraction of total predicted wire time (percent)."""
+        return self.exposed_us / self.wire_us * 100.0 if self.wire_us else 0.0
+
+    def by_key(self) -> dict:
+        return {s.key: s for s in self.sites}
+
+    def format(self) -> str:
+        lines = [
+            f"predicted overlap [{self.device}]: {self.wire_us:.1f}us wire, "
+            f"{self.hidden_us:.1f}us hidden, {self.exposed_us:.1f}us exposed "
+            f"({self.exposed_pct:.1f}%)",
+            f"  {'site':<26} {'axis':<6} {'wire us':>9} {'window':>9} "
+            f"{'hidden':>9} {'exposed':>9}",
+        ]
+        for s in sorted(self.sites, key=lambda s: -s.wire_us):
+            lines.append(
+                f"  {s.label():<26.26} {s.axis or '-':<6} {s.wire_us:>9.2f} "
+                f"{s.window_us:>9.2f} {s.hidden_us:>9.2f} {s.exposed_us:>9.2f}"
+            )
+        return "\n".join(lines)
+
+    def __str__(self) -> str:
+        return self.format()
+
+
+def predict_overlap(trace: TraceCtx, *, device: Any = None,
+                    cert: Optional[ScheduleCertificate] = None) -> OverlapPrediction:
+    """Predict, per collective site, how much of its wire time hides under
+    the compute between the site and its first consumer.
+
+    Model: a collective issued at its trace position completes no later
+    than its first consumer; the roofline time of the non-collective bsyms
+    strictly between the two is the overlap window (certified independent —
+    they neither produce the site's operands nor consume its outputs).
+    Windows share compute: each line's budget is consumed by sites in
+    program order, so two collectives cannot both claim the same GEMM.
+    ``hidden = min(wire, window-budget consumed)``; the rest is exposed.
+    The comm scheduler (transforms/comm_schedule.py) moves sites inside
+    their certified intervals to maximize exactly this number, and the
+    ``sched.exposed-collective`` rule reports it per site."""
+    from thunder_tpu.analysis.cost import resolve_device_spec, trace_cost
+
+    dev = resolve_device_spec(device)
+    if cert is None:
+        cert = certify(trace)
+    tc = trace_cost(trace, dev)
+    compute_us: dict[int, float] = {}
+    wire_by_index: dict[int, float] = {}
+    for r in tc.rows:
+        if r.kind == "collective":
+            wire_by_index[r.index] = r.roofline_s * 1e6
+        else:
+            compute_us[r.index] = r.roofline_s * 1e6
+
+    pred = OverlapPrediction(device=dev.name)
+    budget = dict(compute_us)
+    for site in sorted(cert.sites, key=lambda s: s.index):
+        wire = wire_by_index.get(site.index, 0.0)
+        c = site.first_consumer
+        window = 0.0
+        hidden = 0.0
+        if c is not None:
+            for j in range(site.index + 1, c):
+                avail = budget.get(j, 0.0)
+                window += compute_us.get(j, 0.0)
+                if avail and hidden < wire:
+                    take = min(avail, wire - hidden)
+                    budget[j] = avail - take
+                    hidden += take
+        pred.sites.append(SiteOverlap(
+            index=site.index, sym=site.sym, axis=site.axis, key=site.key,
+            wire_us=wire, window_us=window, hidden_us=min(hidden, wire),
+            first_consumer=c,
+        ))
+    pred.residual_budget = budget
+    return pred
 
 
 def _bsym_index_of_key(bsyms, key: str) -> Optional[int]:
@@ -375,3 +525,47 @@ def uncertified_reorder(ctx: VerifyContext) -> None:
     # re-verify of the same flagged trace would report clean.
     if not found_inversion:
         ctx.trace.tags["collective_order"] = current
+
+
+# Sub-µs wire predictions are bookkeeping noise (replicated synchronize,
+# zero-factor ops) — the advisory rule only reports sites worth scheduling.
+_EXPOSED_RULE_MIN_WIRE_US = 1.0
+
+
+@register_rule(
+    "sched.exposed-collective",
+    "Collective wire time is predicted hidden under certified-independent compute",
+)
+def exposed_collective(ctx: VerifyContext) -> None:
+    """Advisory (INFO): per collective site, the statically predicted
+    hidden/exposed wire time (:func:`predict_overlap`) — the compile-time
+    twin of the measured lane segmentation. A site whose predicted wire
+    time is mostly exposed is a scheduling opportunity the comm scheduler
+    (``transforms/comm_schedule.py``) either already declined (pinned, or
+    a liveness back-off) or has not seen. Never an error: exposure is a
+    speed bug, not a correctness one."""
+    from thunder_tpu.distributed.prims import is_collective_bsym
+
+    if not any(is_collective_bsym(b) for b in ctx.bsyms):
+        return
+    try:
+        pred = predict_overlap(ctx.trace, cert=certify(ctx.trace, ctx=ctx))
+    except Exception:  # noqa: BLE001 — advisory prediction must never break verify
+        return
+    for s in pred.sites:
+        if s.wire_us < _EXPOSED_RULE_MIN_WIRE_US or s.exposed_us <= 0.0:
+            continue
+        ctx.report(
+            "sched.exposed-collective",
+            Severity.INFO,
+            f"{s.label()} [{s.axis or '-'}]: predicted {s.exposed_us:.1f}us of "
+            f"{s.wire_us:.1f}us wire exposed ({s.hidden_us:.1f}us hidden under "
+            f"the {s.window_us:.1f}us window to its consumer"
+            + (f" at L{s.first_consumer}" if s.first_consumer is not None else "")
+            + ")",
+            bsym_index=s.index,
+            hint="transforms/comm_schedule.schedule_collectives moves the site "
+            "inside its certified [earliest, latest] interval to grow the "
+            "window; a pinned or backed-off site needs more independent "
+            "compute or a smaller transfer (quantized collectives)",
+        )

@@ -688,11 +688,17 @@ def _compile_entry_impl(
         and on_nan_opt != "rerun-instrumented"
         and jaxex._donation_active()
     )
-    static_plan, static_cert = _static_planner(
+    extrace, static_plan, static_cert = _static_planner(
         extrace, sym_spec,
         donate=donate_buckets,
         rerun_capable=on_nan_opt == "rerun-instrumented",
+        # The comm scheduler rides the same advisory phase; the de-opt
+        # ladder disables it from L1 up (like fusion) so a bad schedule
+        # demotes cleanly through the existing recovery loop.
+        comm_schedule=deopt_level < 1,
     )
+    if extrace is not claimed_extrace:
+        computation_traces.append(extrace)
     phases["static_analysis"] = (timer_ns() - _phase_mark) / 1e9
     _phase_mark = timer_ns()  # codegen span starts after the planner
 
@@ -804,6 +810,7 @@ def _compile_entry_impl(
         entry.stats.predicted_peak_bytes = int(static_plan.peak_bytes)
     entry.schedule_certificate = static_cert
     cs.trace_seconds += entry.stats.trace_s
+    comm_sched_tag = extrace.tags.get("comm_schedule")
     for phase in ("trace", "transforms", "claim", "static_analysis", "codegen",
                   "staging"):
         extra = {}
@@ -812,6 +819,13 @@ def _compile_entry_impl(
                 predicted_peak_bytes=int(static_plan.peak_bytes),
                 collective_sites=len(static_cert.sites) if static_cert else 0,
             )
+            # Comm-scheduler outcome, by PRESENCE only: entries the pass
+            # never touched (no collectives, disabled, de-opted) carry none.
+            if comm_sched_tag:
+                extra["comm_schedule_moves"] = comm_sched_tag.get("moves")
+                extra["comm_schedule_exposed_pct"] = comm_sched_tag.get(
+                    "exposed_pct_after"
+                )
         _record_compile_phase(compile_id, phase, phases.get(phase, 0.0), **extra)
 
     # Observability: compile-side metrics + the compile_end event carrying
@@ -850,12 +864,15 @@ def _compile_entry_impl(
 
 
 def _static_planner(extrace: TraceCtx, sym_spec, *, donate: bool,
-                    rerun_capable: bool):
-    """The compile pipeline's static_analysis phase (ISSUE 10): stamp
-    donation metadata on the claimed execution trace, plan its HBM liveness,
-    and certify its collective schedule. Returns ``(MemoryPlan | None,
-    ScheduleCertificate | None)`` — planning failures degrade to None, never
-    break a compile."""
+                    rerun_capable: bool, comm_schedule: bool = False):
+    """The compile pipeline's static_analysis phase (ISSUE 10 + 13): stamp
+    donation metadata on the claimed execution trace, run the certificate-
+    driven collective-overlap scheduler (``transforms/comm_schedule.py`` —
+    the donation tags must land first so its liveness back-off prices the
+    real plan), plan the result's HBM liveness, and certify its collective
+    schedule. Returns ``(extrace, MemoryPlan | None, ScheduleCertificate |
+    None)`` — planning/scheduling failures degrade to the input trace and
+    None, never break a compile."""
     try:
         from thunder_tpu.analysis import liveness as live_mod
         from thunder_tpu.analysis import schedule as sched_mod
@@ -869,16 +886,23 @@ def _static_planner(extrace: TraceCtx, sym_spec, *, donate: bool,
         extrace.tags["donated_inputs"] = donated_names
         if rerun_capable:
             extrace.tags["rerun_reads_inputs"] = True
+        if comm_schedule:
+            from thunder_tpu.transforms import comm_schedule as comm_sched
+
+            if comm_sched.enabled():
+                extrace, _ = comm_sched.schedule_collectives(extrace)
         plan = live_mod.plan_liveness(
             extrace, donated=donated_names, include_rows=False
         )
         # Certify + stamp the per-axis collective order baseline; the
         # sched.uncertified-reorder rule diffs later passes against it, and
-        # the watchdog attaches the axis order to timeout diagnoses.
+        # the watchdog attaches the axis order to timeout diagnoses. (The
+        # scheduler already recertified its own output; stamping again is
+        # idempotent on the preserved per-axis order.)
         cert = sched_mod.stamp(extrace)
-        return plan, cert
+        return extrace, plan, cert
     except Exception:  # noqa: BLE001 — the planner is advisory, never fatal
-        return None, None
+        return extrace, None, None
 
 
 def _resolve_instrument_hooks(cd: CompileData) -> tuple:

@@ -53,9 +53,16 @@ def compile_with_collectives(
     out_specs,
     *,
     grad: bool = False,
+    comm_schedule: bool = False,
+    comm_schedule_opts: Optional[dict] = None,
 ):
     """Trace ``fn`` through the framework pipeline (so dist_prims record into
     the trace), then stage the claimed trace under shard_map over ``mesh``.
+
+    ``comm_schedule=True`` runs the certificate-driven collective-overlap
+    scheduler over the claimed trace first (transforms/comm_schedule.py):
+    fsdp ``synchronize`` gathers hoist to async-prefetch positions, the
+    re-certified trace stages in the scheduled order.
 
     Returns the jitted callable (flat args in trace order).
     """
@@ -65,13 +72,24 @@ def compile_with_collectives(
     from thunder_tpu.transforms.autodiff import grad_transform
     from thunder_tpu.transforms.common import dce
 
-    from thunder_tpu.distributed.prims import collective_trace_lines
-
     _, comp = trace_program(fn, example_args, {})
     comp = dce(comp)
     if grad:
         comp = grad_transform(comp, return_value=True)
-    extrace = transform_for_execution(comp, resolve_executors(None))
+    extrace = transform_for_execution(
+        comp, resolve_executors(None),
+        comm_schedule=comm_schedule, comm_schedule_opts=comm_schedule_opts,
+    )
+    return stage_collective_trace(extrace, mesh, in_specs, out_specs), extrace
+
+
+def stage_collective_trace(extrace, mesh, in_specs, out_specs) -> Callable:
+    """Stage an already-claimed collective-bearing execution trace under
+    shard_map over ``mesh`` (the tail of :func:`compile_with_collectives`,
+    split out so callers holding a transformed trace — e.g. one rewritten
+    by the comm scheduler — can restage it without re-tracing)."""
+    from thunder_tpu.distributed.prims import collective_trace_lines
+
     inner = extrace.python_callable()
     # Certify the collective schedule (ISSUE 10): stamps the per-axis order
     # baseline on the trace and hands the watchdog the certified order so a
@@ -84,9 +102,8 @@ def compile_with_collectives(
         schedule = sched_mod.stamp(extrace).axis_labels()
     except Exception:  # noqa: BLE001
         pass
-    jf = shard_map_callable(
+    return shard_map_callable(
         inner, mesh, in_specs, out_specs,
         trace_lines=collective_trace_lines(extrace),
         schedule=schedule,
     )
-    return jf, extrace

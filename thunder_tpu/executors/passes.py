@@ -43,7 +43,19 @@ def _claimed(sym: Symbol, ex: Executor) -> Symbol:
     return new
 
 
-def transform_for_execution(trace: TraceCtx, executors_list: Sequence[Executor]) -> TraceCtx:
+def transform_for_execution(
+    trace: TraceCtx,
+    executors_list: Sequence[Executor],
+    *,
+    comm_schedule: bool = False,
+    comm_schedule_opts: dict | None = None,
+) -> TraceCtx:
+    """Claim every bound symbol, run fusion passes, and — when
+    ``comm_schedule=True`` and ``THUNDER_TPU_COMM_SCHEDULE`` permits — run
+    the certificate-driven collective-overlap scheduler
+    (``transforms/comm_schedule.py``) over the claimed trace.
+    ``comm_schedule_opts`` forwards ``device``/``capacity_bytes``/
+    ``arg_divisors`` to the scheduler."""
     start = time.perf_counter_ns()
     executors_list = tuple(executors_list) + get_always_executors()
     new_bsyms: list[BoundSymbol] = []
@@ -99,7 +111,16 @@ def transform_for_execution(trace: TraceCtx, executors_list: Sequence[Executor])
 
     extrace.tags["claim_breakdown"] = _claim_breakdown(extrace)
     extrace.tags["collective_bytes"] = _collective_bytes(extrace)
-    return wrap_in_trace_provenance(extrace, "Transform for execution", start)
+    extrace = wrap_in_trace_provenance(extrace, "Transform for execution", start)
+
+    if comm_schedule:
+        from thunder_tpu.transforms import comm_schedule as comm_sched
+
+        if comm_sched.enabled():
+            extrace, _ = comm_sched.schedule_collectives(
+                extrace, **(comm_schedule_opts or {})
+            )
+    return extrace
 
 
 def _claim_breakdown(trace: TraceCtx) -> dict[str, int]:

@@ -682,10 +682,12 @@ def _join_collectives(attr: Attribution, cost: Optional[Any], steps: int) -> lis
     cost_by_line: dict[tuple[int, str], float] = {}
     cost_by_cls: dict[str, float] = {}
     if cost is not None and getattr(cost.device, "ici_bw", 0.0):
-        ici_bw = cost.device.ici_bw
         for r in cost.rows:
             if r.kind != "collective" or not r.comm_bytes:
                 continue
+            # Per-family effective bandwidth when the spec was calibrated
+            # (analysis/cost.calibrate_ici); datasheet ici_bw otherwise.
+            ici_bw = cost.device.ici_bw_for(COLLECTIVE_SYM_CLASS.get(r.sym))
             wire_us = r.comm_bytes / ici_bw * 1e6
             cost_by_line[(r.index, r.sym)] = cost_by_line.get((r.index, r.sym), 0.0) + wire_us
             cls = COLLECTIVE_SYM_CLASS.get(r.sym)

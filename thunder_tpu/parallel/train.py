@@ -81,9 +81,18 @@ def adamw_update(params, grads, state, *, lr=1e-3, b1=0.9, b2=0.95, eps=1e-8, we
 # =============================================================================
 
 
-def _compile_loss_and_grads(config: GPTConfig, params, idx, targets, executors=None):
+def _compile_loss_and_grads(config: GPTConfig, params, idx, targets, executors=None,
+                            *, mesh=None, param_specs=None, comm_schedule=True):
     """Trace loss_fn through the framework pipeline → a pure jax callable
-    taking the flat tensor leaves and returning (loss, grads_tuple)."""
+    taking the flat tensor leaves and returning (loss, grads_tuple).
+
+    ``comm_schedule`` runs the certificate-driven collective-overlap
+    scheduler (transforms/comm_schedule.py) over the claimed joint trace —
+    a no-op when the trace routes its collectives through the SPMD
+    partitioner instead of dist_prims, so the pjit path keeps its exact
+    program; trace-level FSDP/TP steps get their gathers prefetched. The
+    mesh/param_specs (when given) divide sharded inputs so the scheduler's
+    liveness back-off prices per-device bytes."""
     from thunder_tpu.api import trace_program
     from thunder_tpu.executors.passes import transform_for_execution
     from thunder_tpu.extend import resolve_executors
@@ -97,7 +106,21 @@ def _compile_loss_and_grads(config: GPTConfig, params, idx, targets, executors=N
     comp = dce(comp)
     joint = grad_transform(comp, return_value=True)
     joint = save_sdpa_residuals_joint(joint, ex_list)
-    extrace = transform_for_execution(joint, ex_list)
+    divisors = None
+    if mesh is not None and param_specs is not None:
+        from thunder_tpu.analysis.liveness import arg_divisors_from_specs
+
+        try:
+            # The joint trace shares its args with the claimed trace, so
+            # the divisors computed here hold for the scheduler's input.
+            divisors = arg_divisors_from_specs(joint, param_specs, mesh=mesh)
+        except Exception:  # noqa: BLE001 — divisors refine, never gate
+            divisors = None
+    extrace = transform_for_execution(
+        joint, ex_list,
+        comm_schedule=comm_schedule,
+        comm_schedule_opts={"arg_divisors": divisors} if divisors else None,
+    )
     return extrace.python_callable(), extrace
 
 
@@ -133,7 +156,10 @@ def build_train_step(
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec
 
-    loss_and_grads, extrace = _compile_loss_and_grads(config, params, idx, targets, executors=executors)
+    loss_and_grads, extrace = _compile_loss_and_grads(
+        config, params, idx, targets, executors=executors,
+        mesh=mesh, param_specs=param_specs,
+    )
 
     def step(params, opt_state, idx, targets):
         flat, _ = tree_flatten(((params, idx, targets), {}))

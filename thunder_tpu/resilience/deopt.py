@@ -7,7 +7,10 @@ backoff:
 
 ====  ==========================================================
 L0    normal compilation
-L1    disable fusion passes and XLA buffer donation
+L1    disable fusion passes, the collective-overlap scheduler
+      (transforms/comm_schedule.py — a bad schedule demotes to the
+      certified program order instead of wedging), and XLA buffer
+      donation
 L2    L1 + aggressive rematerialization (transforms/rematerialization
       recomputes longer chains regardless of saved-byte accounting)
 L3    L2 + exact shapes (no bucket padding; shrinks live memory for
