@@ -209,6 +209,42 @@ def bench_fsdp_tp(args, result: dict) -> None:
              f"{spawn_s * 1e6:.0f}us over a {step_s * 1e3:.1f}ms median step "
              f"= {overhead_pct:+.2f}%")
 
+        # Tiered-checkpoint hot-path stall (ISSUE 14): the device→host
+        # snapshot of the full train state (params + opt) — the ONLY cost
+        # a snapshot_every=1 cadence would add to each step; the disk
+        # protocol rides the background writer. Contrast with the
+        # synchronous save the pre-tiered path paid at every cadence hit.
+        import tempfile
+
+        from thunder_tpu.resilience.preemption import CheckpointManager
+        from thunder_tpu.resilience.snapshot import SnapshotStore
+
+        import shutil
+
+        ck_dir = tempfile.mkdtemp(prefix="ttpu_bench_ck_")
+        try:
+            store = SnapshotStore(host=0, ring=2)
+            SnapshotStore.pair(store, SnapshotStore(host=1, ring=2))
+            cmgr = CheckpointManager(ck_dir, backoff_s=0, store=store,
+                                     async_flush=True)
+            stalls = []
+            for i in range(6):
+                t0 = time.perf_counter()
+                cmgr.snapshot((p, o), i)
+                stalls.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            cmgr.save((p, o), 99)
+            sync_save_s = time.perf_counter() - t0
+            cmgr.close()
+        finally:
+            shutil.rmtree(ck_dir, ignore_errors=True)
+        stall_ms = med(stalls) * 1e3
+        result["checkpoint_stall_ms_per_step"] = round(stall_ms, 3)
+        result["checkpoint_sync_save_ms"] = round(sync_save_s * 1e3, 2)
+        _log(f"checkpoint tiers: snapshot stall {stall_ms:.2f}ms "
+             f"(replicated to buddy) vs {sync_save_s * 1e3:.0f}ms "
+             f"synchronous save")
+
     # Aggregate MFU: the traced program computes the GLOBAL batch, so its
     # FLOPs divide across every chip — MFU is flops / (t · n · per-chip peak).
     spec = resolve_device_spec(args.device_spec)

@@ -837,7 +837,16 @@ _SOAK_REQUIRED_KEYS = (
     "soak_faults_injected",
     "soak_fault_seams", "soak_overlapping_pairs", "soak_decisions",
     "soak_unrecovered", "soak_unactuated",
+    # Tiered checkpointing (ISSUE 14).
+    "checkpoint_stall_ms_per_step", "snapshot_every", "soak_snapshots",
+    "soak_restore_tiers", "soak_restore_fallthroughs",
 )
+
+# The hot loop's amortized checkpoint cost must stay snapshot-shaped (a
+# device→host copy every few steps). A synchronous disk save leaking back
+# onto the hot path costs ~100ms+ per cadence hit — far past this cap even
+# on a loaded CI machine.
+_SOAK_STALL_MS_PER_STEP_CAP = 25.0
 
 # The four autopilot policy classes the smoke must see decided at least
 # once (the schedule's REQUIRED_SEAMS guarantee the triggering faults).
@@ -846,15 +855,74 @@ _SOAK_POLICY_CLASSES = (
 )
 
 
+def _torn_fallthrough_check() -> int:
+    """Deterministic torn-write disk fall-through (ISSUE 14 satellite): a
+    ``snap_torn`` background flush leaves its step directory WITHOUT the
+    META commit marker; the tiered restore must skip the incomplete step
+    and land on the older complete one — asserted from the replayed event
+    log, not from in-process state. Returns the error count."""
+    import json
+    import tempfile
+
+    import numpy as np
+
+    import thunder_tpu.monitor as monitor
+    from thunder_tpu.analysis.events import replay_events
+    from thunder_tpu.resilience import chaos, elastic
+    from thunder_tpu.resilience.preemption import CheckpointManager
+
+    tmp = tempfile.mkdtemp(prefix="ttpu_torn_")
+    log = os.path.join(tmp, "ev.jsonl")
+    n_errors = 0
+    monitor.set_event_log(log)
+    try:
+        mgr = CheckpointManager(os.path.join(tmp, "ck"), backoff_s=0,
+                                async_flush=True)
+        state = {"p": np.arange(8, dtype=np.float32)}
+        mgr.save(state, 10)
+        with chaos.chaos_scope("snap_torn"):
+            mgr.snapshot(state, 20, flush=True)
+            mgr.close()  # drain: the torn flush's events are in the log
+        _, meta, tier, _tried = elastic.tiered_restore(mgr)
+    finally:
+        monitor.set_event_log(None)
+    if not (tier == "disk" and meta["step"] == 10):
+        n_errors += 1
+        print(f"    FAILED: torn fall-through restored {tier}@{meta['step']} "
+              f"(want disk@10)")
+    summary, diags = replay_events(log)
+    records = [json.loads(line) for line in open(log)]
+    torn_flush = any(r["kind"] == "snapshot_flush" and not r["ok"]
+                     and r.get("reason") == "torn" for r in records)
+    skipped = any(r["kind"] == "checkpoint_restore" and not r["ok"]
+                  for r in records)
+    if not (torn_flush and skipped):
+        n_errors += 1
+        print(f"    FAILED: torn-write log shape (torn_flush={torn_flush}, "
+              f"incomplete-skip={skipped})")
+    if summary.get("unrecovered_faults"):
+        n_errors += 1
+        print(f"    FAILED: snap_torn unrecovered: "
+              f"{summary['unrecovered_faults']}")
+    if not n_errors:
+        print("    torn-write fall-through OK: flush tore at step 20, "
+              "restore skipped it and landed on disk@10")
+    return n_errors
+
+
 def _soak_smoke() -> int:
     """--soak: the fleet-autopilot soak smoke (ISSUE 11 satellite). Runs a
     short deterministic ``scripts/soak_fleet.py --smoke`` on the 8-device
     virtual mesh and asserts: zero unrecovered faults, zero unactuated
     decisions, at least one decision of EVERY policy class, every required
     seam kind injected, and a per-fault recovery cost within the soak
-    noise floor of the committed ``SOAK_r*.json`` round. Full runs
-    additionally gate the committed series with ``perf_report --gate``.
-    Returns the error count."""
+    noise floor of the committed ``SOAK_r*.json`` round. Tiered
+    checkpointing (ISSUE 14): also asserts a bounded
+    ``checkpoint_stall_ms_per_step``, at least one RAM-tier and one
+    disk-tier restore, a restore that FELL THROUGH an invalid tier, and
+    (in-process) the deterministic torn-write disk fall-through — all from
+    replayed event logs. Full runs additionally gate the committed series
+    with ``perf_report --gate``. Returns the error count."""
     import glob
     import json
     import subprocess
@@ -910,6 +978,34 @@ def _soak_smoke() -> int:
         print(f"    schedule OK: {result.get('soak_faults_injected')} faults "
               f"across {len(seams)} seam kinds, "
               f"{result['soak_overlapping_pairs']} overlapping pair(s)")
+
+    # Tiered checkpointing (ISSUE 14): the soak's own replay computed these
+    # from its event log (soak_fleet derives them via replay_events).
+    stall = result.get("checkpoint_stall_ms_per_step")
+    if not isinstance(stall, (int, float)) or not (
+            0.0 < stall <= _SOAK_STALL_MS_PER_STEP_CAP):
+        n_errors += 1
+        print(f"    FAILED: checkpoint_stall_ms_per_step={stall} not in "
+              f"(0, {_SOAK_STALL_MS_PER_STEP_CAP}] — snapshots missing, or "
+              f"disk IO leaked back onto the hot path")
+    else:
+        print(f"    stall OK: {stall:.2f} ms/step over "
+              f"{result.get('soak_snapshots')} snapshots")
+    tiers = result.get("soak_restore_tiers") or {}
+    ram = (tiers.get("local") or 0) + (tiers.get("peer") or 0)
+    if not ram or not tiers.get("disk"):
+        n_errors += 1
+        print(f"    FAILED: restore-tier coverage {tiers} (need >=1 RAM-tier "
+              f"and >=1 disk-tier restore)")
+    elif not result.get("soak_restore_fallthroughs"):
+        n_errors += 1
+        print(f"    FAILED: no restore fell through an invalid tier "
+              f"(snap_corrupt must force the checksum gate; tiers={tiers})")
+    else:
+        print(f"    tiers OK: " + ", ".join(
+            f"{t}×{n}" for t, n in sorted(tiers.items()))
+            + f"; {result['soak_restore_fallthroughs']} fall-through(s)")
+    n_errors += _torn_fallthrough_check()
 
     # Goodput sanity vs the committed round. The goodput RATIO swings with
     # the machine's ideal step time (the CPU mesh cannot hold it steady

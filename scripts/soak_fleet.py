@@ -57,23 +57,34 @@ def _log(msg: str) -> None:
 # Every required seam appears at least once so each autopilot policy class
 # is exercised on any seed: host_loss/collective_hang -> elastic_resume,
 # sdc -> quarantine_rerun, oom -> deopt_escalate, preempt ->
-# checkpoint_halt, ckpt_io -> the manager's own retry.
-REQUIRED_SEAMS = ("host_loss", "collective_hang", "sdc", "oom", "ckpt_io", "preempt")
+# checkpoint_halt, ckpt_io -> the manager's own retry; the tiered-
+# checkpoint seams (ISSUE 14) -> the snapshot pipeline degrades one tier
+# and keeps going (torn/slow flush -> a later commit; corrupt replica ->
+# the restore ladder's checksum fall-through).
+REQUIRED_SEAMS = ("host_loss", "collective_hang", "sdc", "oom", "ckpt_io",
+                  "preempt", "snap_torn", "snap_corrupt", "snap_slow")
 # The filler pool excludes preempt: each preempt is a full
 # checkpoint-and-halt + process-restart cycle, and one per soak is the
 # scenario; a schedule of mostly restarts would measure restart latency,
-# not goodput under churn.
+# not goodput under churn. It also excludes the snap seams: they are
+# near-free by design, and padding the schedule with them would flatter
+# the per-fault recovery number instead of stressing the heavy actuators.
 FILLER_SEAMS = ("host_loss", "collective_hang", "sdc", "oom", "ckpt_io")
+# Seams that fire lazily at a later seam visit (a background flush, a
+# tiered restore) rather than at their trigger step.
+_LAZY_SNAP_SEAMS = ("snap_torn", "snap_slow")
 
 
 @dataclass
 class ScheduledFault:
     """One schedule entry: ``seam`` is armed at the end of ``step`` (so it
     fires on step+1's boundary/dispatch). Entries sharing a ``step`` are an
-    overlapping pair — both armed before either recovery runs."""
+    overlapping pair — both armed before either recovery runs. ``target``
+    carries a seam-specific target clause (the snap_corrupt tier)."""
 
     step: int
     seam: str
+    target: str = None
 
 
 def make_schedule(seed: int, n_steps: int, n_faults: int,
@@ -81,7 +92,16 @@ def make_schedule(seed: int, n_steps: int, n_faults: int,
     """Deterministic mixed-fault schedule: ``n_faults`` events over
     ``n_steps`` steps, covering every REQUIRED_SEAMS kind, with
     ``overlap_pairs`` of them sharing a trigger step (arriving before the
-    prior fault's recovery has run). Same seed → same schedule."""
+    prior fault's recovery has run). Same seed → same schedule.
+
+    Tiered-checkpoint seams get special placement: ``snap_torn``/
+    ``snap_slow`` fire at the NEXT background flush, so they are pinned
+    into the early third of the run (armed at the tail they would never
+    see a flush and never inject); ``snap_corrupt`` fires at the next
+    tiered restore, so it is co-scheduled onto an elastic-driving fault's
+    step (host_loss/collective_hang — whose recovery IS a restore) and
+    targets the local tier, forcing the ladder through the buddy
+    replica."""
     if n_faults < len(REQUIRED_SEAMS):
         raise ValueError(
             f"need at least {len(REQUIRED_SEAMS)} faults to cover every seam"
@@ -120,6 +140,23 @@ def make_schedule(seed: int, n_steps: int, n_faults: int,
     for seam in seams[n_slots:]:
         host = rng.choice(candidates)
         schedule.append(ScheduledFault(host.step, seam))
+    # Tiered-checkpoint seam placement (docstring): torn/slow flush seams
+    # must still have a flush ahead of them; a corrupted replica must have
+    # a restore ahead of it.
+    preempt_steps = {f.step for f in schedule if f.seam == "preempt"}
+    early_hi = lo + max(3, (hi - lo) // 3)
+    for f in schedule:
+        if f.seam in _LAZY_SNAP_SEAMS and f.step > early_hi:
+            step = lo + rng.randrange(max(1, early_hi - lo))
+            while step in preempt_steps:
+                step = lo + rng.randrange(max(1, early_hi - lo))
+            f.step = step
+    elastic_hosts = [f for f in schedule
+                     if f.seam in ("host_loss", "collective_hang")]
+    for f in schedule:
+        if f.seam == "snap_corrupt" and elastic_hosts:
+            f.step = rng.choice(elastic_hosts).step
+            f.target = "local"
     schedule.sort(key=lambda f: (f.step, f.seam))
     return schedule
 
@@ -144,7 +181,15 @@ def arm_fault(cfg, fault: ScheduledFault, *, hang_delay_s: float) -> None:
         cfg.rules.append(FaultRule(seam, target=str(fault.step + 1)))
     elif seam == "collective_hang":
         cfg.rules.append(FaultRule(seam, delay_s=hang_delay_s))
-    else:  # sdc, oom, ckpt_io: fire at their next seam visit
+    elif seam == "snap_slow":
+        # A slow flush must be slow relative to the flush cadence so the
+        # single-in-flight backpressure actually coalesces behind it, but
+        # must not dwarf the recovery budget it rides in.
+        cfg.rules.append(FaultRule(seam, delay_s=min(1.0, hang_delay_s / 4)))
+    elif seam == "snap_corrupt":
+        # Fires at the next tiered restore; the target picks the tier(s).
+        cfg.rules.append(FaultRule(seam, target=fault.target or "local"))
+    else:  # sdc, oom, ckpt_io, snap_torn: fire at their next seam visit
         cfg.rules.append(FaultRule(seam))
 
 
@@ -305,8 +350,23 @@ def run_soak(args) -> dict:
     for pol in policies.values():
         pol.window_s = min(pol.window_s, args.hysteresis_window_s)
     autopilot = ap_mod.Autopilot(policies=policies)
-    mgr = CheckpointManager(os.path.join(tmp, "ckpt"), keep=3,
-                            backoff_s=0.01)
+
+    def fresh_manager():
+        # Tiered checkpointing (ISSUE 14): a local RAM ring buddy-paired
+        # with a peer store (the virtual-mesh stand-in for replicating
+        # shards to another host) + the async background disk writer. A
+        # restart gets a FRESH pair — the next allocation's RAM starts
+        # empty, disk is the only tier that survives a process death.
+        from thunder_tpu.resilience.snapshot import SnapshotStore
+
+        store = SnapshotStore(host=0, ring=args.snapshot_ring)
+        buddy = SnapshotStore(host=1, ring=args.snapshot_ring)
+        SnapshotStore.pair(store, buddy)
+        return CheckpointManager(os.path.join(tmp, "ckpt"), keep=3,
+                                 backoff_s=0.01, store=store,
+                                 async_flush=True)
+
+    mgr = fresh_manager()
 
     armed: set = set()
 
@@ -335,7 +395,8 @@ def run_soak(args) -> dict:
                     manager=mgr, mesh=mesh, specs_for_mesh=specs_for_mesh,
                     sdc_guard=True,
                     watchdog_timeout_s=args.watchdog_timeout_s,
-                    save_every=args.save_every, on_step=on_step,
+                    save_every=args.save_every,
+                    snapshot_every=args.snapshot_every, on_step=on_step,
                     regrow_after=args.regrow_after,
                 )
                 reports.append(report)
@@ -343,15 +404,20 @@ def run_soak(args) -> dict:
             except ap_mod.AutopilotHalt as e:
                 # A checkpoint_halt landed (preemption or exhausted ladder):
                 # the durable checkpoint exists; "the next allocation"
-                # resumes — same process, fresh driver call.
+                # resumes — same process, fresh driver call with EMPTY RAM
+                # tiers (only disk survives a process death; the restart's
+                # first restore is the soak's disk-tier coverage).
                 if e.report is not None:
                     reports.append(e.report)
                 halts += 1
+                mgr.close()
+                mgr = fresh_manager()
                 _log(f"halt #{halts}: {e} — restarting from the checkpoint")
                 if halts > args.max_restarts:
                     raise RuntimeError(
                         f"soak exceeded {args.max_restarts} restarts"
                     ) from e
+    mgr.close()  # drain the background writer: every flush event must land
     wall_s = time.perf_counter() - wall0
     for report in reports:
         for i, v in enumerate(report.losses):
@@ -418,6 +484,16 @@ def run_soak(args) -> dict:
         "soak_restarts": halts,
         "soak_steps_executed": steps_executed,
         "soak_final_loss": losses[-1],
+        # Tiered checkpointing (ISSUE 14), all derived from the replayed
+        # event log: the amortized hot-path stall of the snapshot cadence,
+        # where restores landed on the tier ladder, and how many fell
+        # through an invalid tier (the chaos seams' visible recovery).
+        "checkpoint_stall_ms_per_step": round(
+            float(summary.get("snapshot_stall_ms_total") or 0.0) / args.steps, 3),
+        "snapshot_every": args.snapshot_every,
+        "soak_snapshots": summary.get("snapshots") or 0,
+        "soak_restore_tiers": summary.get("restore_tiers") or {},
+        "soak_restore_fallthroughs": summary.get("restore_fallthroughs") or 0,
         "events_log": log,
     }
     _log(f"goodput {goodput:.0f} tok/s ({ratio * 100:.1f}% of ideal "
@@ -426,6 +502,12 @@ def run_soak(args) -> dict:
          f"{sum(result['soak_decisions'].values())} decisions, "
          f"{halts} restart(s), unrecovered={result['soak_unrecovered']}, "
          f"unactuated={result['soak_unactuated']}")
+    _log(f"tiers: {result['soak_snapshots']} snapshots "
+         f"(stall {result['checkpoint_stall_ms_per_step']:.2f} ms/step), "
+         f"restores "
+         + (", ".join(f"{t}×{n}" for t, n in
+                      sorted(result['soak_restore_tiers'].items())) or "none")
+         + f", {result['soak_restore_fallthroughs']} fall-through(s)")
     return result
 
 
@@ -460,6 +542,13 @@ def main(argv=None) -> int:
     p.add_argument("--overlap-pairs", type=int, default=2)
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--save-every", type=int, default=10)
+    p.add_argument("--snapshot-every", type=int, default=3,
+                   help="RAM-snapshot cadence in steps (ISSUE 14: a fault "
+                        "loses at most this many steps instead of "
+                        "save-every)")
+    p.add_argument("--snapshot-ring", type=int, default=4,
+                   help="snapshots kept per RAM tier (local ring and buddy "
+                        "replica ring)")
     p.add_argument("--watchdog-timeout-s", type=float, default=2.0)
     p.add_argument("--hysteresis-window-s", type=float, default=15.0,
                    help="cap on every policy's hysteresis window (the "
@@ -469,13 +558,14 @@ def main(argv=None) -> int:
                         "back up to the full mesh (0 disables)")
     p.add_argument("--max-restarts", type=int, default=8)
     p.add_argument("--smoke", action="store_true",
-                   help="CI-sized run: 40 steps, 7 faults (lint_traces --soak)")
+                   help="CI-sized run: 40 steps, 10 faults (lint_traces --soak)")
     p.add_argument("--workdir", default=None)
     p.add_argument("--out", default=None, help="also write the JSON here")
     p.add_argument("--_subprocess", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
     if args.smoke:
-        args.steps, args.faults, args.save_every = 40, 7, 5
+        args.steps, args.faults, args.save_every = 40, 10, 5
+        args.snapshot_every = 2
         args.regrow_after = 10
     if not args.regrow_after:
         args.regrow_after = None

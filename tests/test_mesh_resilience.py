@@ -598,7 +598,7 @@ class TestMeshEventSchema:
              "lines": ["L1.synchronize"], "suspected_host": 2},
             {"kind": "host_loss", "step": 3, "host": 1},
             {"kind": "elastic_resume", "step": 3, "from_mesh": {"fsdp": 4},
-             "to_mesh": {"fsdp": 2}, "resharded": True},
+             "to_mesh": {"fsdp": 2}, "resharded": True, "tier": "local"},
             {"kind": "sdc_suspect", "step": 5, "leaves": ["leaf0"]},
             {"kind": "sdc_rerun", "step": 5, "ok": True},
         ])

@@ -1,0 +1,582 @@
+"""Tiered checkpointing tests (ISSUE 14).
+
+The three tentpole claims, each falsifiable here: (1) saving is near-free —
+``CheckpointManager.snapshot`` stalls the hot path only for the
+device→host copy while the tmp→rename→META protocol runs on a background
+writer (single in-flight, latest-wins coalescing, synchronous drain on
+preempt/halt); (2) restores are tiered — ``elastic.tiered_restore`` picks
+the newest valid state across local RAM → buddy-replicated peer RAM →
+disk, crc32-validating each tier (the SDC guard's checksum) and falling
+through on mismatch; (3) the chaos seams (``snap_torn`` / ``snap_corrupt``
+/ ``snap_slow``) each degrade one tier and never wedge, with the replay
+correlation proving it. Plus the satellites: step-keyed (not mtime)
+retention under out-of-order flushes, contextvars surviving onto the
+writer thread, and SIGTERM-during-in-flight-flush committing cleanly.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import thunder_tpu.monitor as monitor
+from thunder_tpu.analysis.diagnostics import Severity
+from thunder_tpu.analysis.events import replay_events
+from thunder_tpu.observability import events as obs_events
+from thunder_tpu.resilience import chaos, elastic
+from thunder_tpu.resilience.preemption import (
+    CheckpointManager,
+    CheckpointRestoreError,
+    Preempted,
+    run_training,
+)
+from thunder_tpu.resilience.snapshot import (
+    Snapshot,
+    SnapshotStore,
+    pytree_crc32,
+    to_host,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolation(monkeypatch):
+    monkeypatch.setenv("THUNDER_TPU_RETRY_BACKOFF_S", "0")
+    monkeypatch.delenv("THUNDER_TPU_CHAOS", raising=False)
+    chaos.reset_env_config()
+    was = monitor.enabled()
+    monitor.disable()
+    monitor.reset()
+    yield
+    monitor.reset()
+    (monitor.enable if was else monitor.disable)()
+    chaos.reset_env_config()
+
+
+def _events(path):
+    return [json.loads(line) for line in open(path)]
+
+
+def _state(v=0.0):
+    import jax.numpy as jnp
+
+    return {"p": jnp.arange(6, dtype=jnp.float32) + v, "n": 3}
+
+
+def _paired_stores(ring=4):
+    a, b = SnapshotStore(host=0, ring=ring), SnapshotStore(host=1, ring=ring)
+    SnapshotStore.pair(a, b)
+    return a, b
+
+
+def _mgr(tmp_path, name="ck", **kw):
+    kw.setdefault("backoff_s", 0)
+    return CheckpointManager(str(tmp_path / name), **kw)
+
+
+def _snap(step, v=0.0):
+    host = to_host(_state(v))
+    return Snapshot(step=step, state=host, rng_seed=7,
+                    crcs=pytree_crc32(host))
+
+
+# =============================================================================
+# SnapshotStore
+# =============================================================================
+
+
+class TestSnapshotStore:
+    def test_ring_bound_and_buddy_replication(self):
+        a, b = _paired_stores(ring=2)
+        for s in (1, 2, 3):
+            assert a.put(_snap(s)) is True  # replicated to the buddy
+        # Ring keeps the newest 2, newest first; the buddy mirrors them
+        # under this host's id.
+        assert [s.step for s in a.local_snapshots()] == [3, 2]
+        assert [s.step for s in a.peer_snapshots()] == [3, 2]
+        assert a.newest_step() == 3
+        # An unpaired store still rings locally, just unreplicated.
+        lone = SnapshotStore(host=9, ring=2)
+        assert lone.put(_snap(1)) is False
+        assert lone.peer_snapshots() == []
+
+    def test_verify_and_copy_on_write_corruption(self):
+        a, b = _paired_stores()
+        a.put(_snap(5))
+        local, peer = a.local_snapshots()[0], a.peer_snapshots()[0]
+        assert local.verify() and peer.verify()
+        # Corrupting the local tier must not bleed into the buddy's copy:
+        # the replicas share arrays, so the flip is copy-on-write.
+        assert a.corrupt_newest("local") is True
+        assert not a.local_snapshots()[0].verify()
+        assert a.peer_snapshots()[0].verify()
+        # Corrupting again targets the newest still-VALID snapshot (an XOR
+        # re-flip would silently re-validate the tier) — with only one
+        # (already bad) local snapshot there is nothing left to corrupt.
+        assert a.corrupt_newest("local") is False
+        assert a.corrupt_newest("peer") is True
+        assert not a.peer_snapshots()[0].verify()
+
+    def test_corrupt_empty_tier_returns_false(self):
+        a, _ = _paired_stores()
+        assert a.corrupt_newest("local") is False
+        assert a.corrupt_newest("peer") is False
+
+    def test_drop_local_models_host_loss(self):
+        a, _ = _paired_stores()
+        a.put(_snap(4))
+        a.drop_local()
+        assert a.local_snapshots() == []
+        assert [s.step for s in a.peer_snapshots()] == [4]
+
+    def test_crc_skips_non_array_leaves(self):
+        host = {"p": np.arange(4, dtype=np.float32), "step": 12, "tag": "x"}
+        crcs = pytree_crc32(host)
+        assert len(crcs) == 1
+        host2 = {"p": np.arange(4, dtype=np.float32), "step": 99, "tag": "y"}
+        assert pytree_crc32(host2) == crcs  # metadata not checksummed
+
+
+# =============================================================================
+# CheckpointManager: snapshot + async flush
+# =============================================================================
+
+
+class TestAsyncCheckpointManager:
+    def test_snapshot_event_and_ram_tier(self, tmp_path):
+        log = str(tmp_path / "ev.jsonl")
+        a, _ = _paired_stores()
+        mgr = _mgr(tmp_path, store=a, async_flush=True)
+        with obs_events.event_scope(obs_events.log_for_path(log)):
+            mgr.snapshot(_state(), 7, rng_seed=11)
+        snaps = [r for r in _events(log) if r["kind"] == "snapshot"]
+        assert len(snaps) == 1
+        assert snaps[0]["step"] == 7 and snaps[0]["replicated"] is True
+        assert snaps[0]["stall_ms"] >= 0 and snaps[0]["ring"] == 1
+        # Nothing touched disk — the RAM tier alone holds the state.
+        assert mgr.latest_complete_step() is None
+        snap = a.local_snapshots()[0]
+        assert snap.rng_seed == 11 and snap.verify()
+
+    def test_background_flush_commits(self, tmp_path):
+        log = str(tmp_path / "ev.jsonl")
+        a, _ = _paired_stores()
+        mgr = _mgr(tmp_path, store=a, async_flush=True)
+        with obs_events.event_scope(obs_events.log_for_path(log)):
+            mgr.snapshot(_state(), 4, rng_seed=11, flush=True)
+            # Wait for the BACKGROUND commit (close() would otherwise win
+            # the race and flush synchronously itself).
+            for _ in range(500):
+                if mgr.latest_complete_step() == 4:
+                    break
+                time.sleep(0.01)
+            mgr.close()
+        assert mgr.latest_complete_step() == 4
+        _, meta = mgr.restore()
+        assert meta["step"] == 4 and meta["rng_seed"] == 11
+        flushes = [r for r in _events(log) if r["kind"] == "snapshot_flush"]
+        assert [f["ok"] for f in flushes] == [True]
+        assert flushes[0]["sync"] is False
+        # The flush also emits the ok checkpoint_save record — the recovery
+        # event the ckpt_io/preempt correlation rules key on.
+        saves = [r for r in _events(log)
+                 if r["kind"] == "checkpoint_save" and r["ok"]]
+        assert len(saves) == 1
+
+    def test_single_inflight_latest_wins_coalescing(self, tmp_path):
+        log = str(tmp_path / "ev.jsonl")
+        mgr = _mgr(tmp_path, async_flush=True)
+        with obs_events.event_scope(obs_events.log_for_path(log)):
+            with chaos.chaos_scope("snap_slow~0.4"):
+                mgr.snapshot(_state(), 1, flush=True)   # slow flush in flight
+                time.sleep(0.05)                        # writer picks it up
+                mgr.snapshot(_state(), 2, flush=True)   # queued
+                mgr.snapshot(_state(), 3, flush=True)   # replaces 2
+                mgr.close()
+        # Step 2 was coalesced away: the disk saw 1 (slow) then 3.
+        assert mgr.steps_on_disk() == [1, 3]
+        flushes = {r["step"]: r for r in _events(log)
+                   if r["kind"] == "snapshot_flush"}
+        assert set(flushes) == {1, 3}
+        assert flushes[3].get("coalesced") == 1
+        # The slow seam fired and its injection correlates as recovered
+        # (the later ok flush) in the replay.
+        summary, diags = replay_events(log)
+        assert "snap_slow@None" in summary["faults_injected"]
+        assert summary["unrecovered_faults"] == []
+
+    def test_sync_save_drains_and_supersedes_pending(self, tmp_path):
+        mgr = _mgr(tmp_path, async_flush=True)
+        with chaos.chaos_scope("snap_slow~0.4"):
+            mgr.snapshot(_state(), 1, flush=True)
+            time.sleep(0.05)
+            mgr.snapshot(_state(), 2, flush=True)  # pending behind the slow one
+            # The synchronous save must wait out the in-flight flush and
+            # discard the pending older snapshot — it is superseded by this
+            # newer durable commit.
+            mgr.save(_state(), 5)
+        mgr.close()
+        assert mgr.steps_on_disk() == [1, 5]
+        assert mgr.latest_complete_step() == 5
+
+    def test_writer_thread_sees_chaos_scope(self, tmp_path):
+        """Satellite: contextvars (chaos scopes, event routing) are copied
+        onto the writer per flush — the ckpt_io seam must fire on the
+        background path and correlate in the same per-scope log."""
+        log = str(tmp_path / "ev.jsonl")
+        mgr = _mgr(tmp_path, retries=3, async_flush=True)
+        with obs_events.event_scope(obs_events.log_for_path(log)):
+            with chaos.chaos_scope("ckpt_io*2"):
+                mgr.snapshot(_state(), 1, flush=True)
+                mgr.close()
+        assert mgr.latest_complete_step() == 1
+        saves = [r for r in _events(log) if r["kind"] == "checkpoint_save"]
+        assert [s["ok"] for s in saves] == [False, False, True]
+        summary, _ = replay_events(log)
+        assert summary["kinds"]["fault_injected"] == 2
+        assert summary["unrecovered_faults"] == []
+
+    def test_flush_retries_exhausted_reports_not_raises(self, tmp_path):
+        log = str(tmp_path / "ev.jsonl")
+        mgr = _mgr(tmp_path, retries=1, async_flush=True)
+        with obs_events.event_scope(obs_events.log_for_path(log)):
+            with chaos.chaos_scope("ckpt_io*inf"):
+                mgr.snapshot(_state(), 1, flush=True)
+                mgr.close()  # must not raise out of the writer
+        assert mgr.latest_complete_step() is None
+        flushes = [r for r in _events(log) if r["kind"] == "snapshot_flush"]
+        assert len(flushes) == 1 and flushes[0]["ok"] is False
+        assert "retries exhausted" in flushes[0]["reason"]
+
+    def test_torn_flush_restore_skips_and_gc_sweeps(self, tmp_path):
+        log = str(tmp_path / "ev.jsonl")
+        mgr = _mgr(tmp_path, async_flush=True)
+        with obs_events.event_scope(obs_events.log_for_path(log)):
+            mgr.save(_state(), 10)
+            with chaos.chaos_scope("snap_torn"):
+                mgr.snapshot(_state(), 20, flush=True)
+                mgr.close()
+            # The torn step is on disk WITHOUT its commit marker.
+            assert mgr.steps_on_disk() == [10, 20]
+            assert mgr.latest_complete_step() == 10
+            _, meta = mgr.restore()  # newest-first scan skips the torn dir
+            assert meta["step"] == 10
+            flushes = [r for r in _events(log) if r["kind"] == "snapshot_flush"]
+            assert flushes[-1]["ok"] is False and flushes[-1]["reason"] == "torn"
+            # A later commit makes the torn dir sweepable debris.
+            mgr.save(_state(), 30)
+            assert 20 not in mgr.steps_on_disk()
+        summary, _ = replay_events(log)
+        assert "snap_torn@None" in summary["faults_injected"]
+        assert summary["unrecovered_faults"] == []
+
+    def test_multi_process_flush_stays_synchronous(self, tmp_path, monkeypatch):
+        """On a real multi-process fleet the background writer is unsafe
+        (host-local coalescing would skew the fleet's Orbax barriers; a
+        META commit could land before peers finished their shards): the
+        flush must fall back to the synchronous save() protocol, commit
+        barrier included."""
+        from thunder_tpu.resilience import preemption
+
+        monkeypatch.setattr(preemption, "_multi_process", lambda: True)
+        a, _ = _paired_stores()
+        mgr = _mgr(tmp_path, store=a, async_flush=True)
+        mgr.snapshot(_state(), 4, rng_seed=11, flush=True)
+        # Committed on return — no writer thread involved, nothing queued.
+        assert mgr.latest_complete_step() == 4
+        assert mgr._pending is None and mgr._writer is None
+
+    def test_torn_flush_never_destroys_committed_step(self, tmp_path):
+        """A real crash between the state write and the META marker leaves
+        an existing committed dir at that step intact — the seam must not
+        rmtree it (the re-executed-step-re-flushes-after-a-rewind case)."""
+        mgr = _mgr(tmp_path, async_flush=True)
+        mgr.save(_state(), 20)
+        with chaos.chaos_scope("snap_torn"):
+            mgr._flush_one(_snap(20))
+        assert mgr.latest_complete_step() == 20  # committed data survives
+        _, meta = mgr.restore()
+        assert meta["step"] == 20
+
+    def test_tiered_restore_drains_inflight_flush(self, tmp_path):
+        """The restore ladder quiesces the background writer before
+        reading the directory — it must not race the rmtree/rename/GC of
+        an in-flight commit."""
+        a, _ = _paired_stores()
+        mgr = _mgr(tmp_path, store=a, async_flush=True)
+        with chaos.chaos_scope("snap_slow~0.4"):
+            mgr.snapshot(_state(), 6, flush=True)
+            time.sleep(0.05)  # the slow flush is now in flight
+            _, meta, tier, _ = elastic.tiered_restore(mgr)
+        assert (tier, meta["step"]) == ("local", 6)
+        # drain() ran: the flush finished before the directory was read.
+        assert mgr._inflight_step is None
+        assert mgr.latest_complete_step() == 6
+        mgr.close()
+
+    def test_preempt_during_inflight_flush(self, tmp_path):
+        """Satellite: SIGTERM while the writer holds an uncommitted tmp —
+        the preemption save must drain the writer and commit, never leave
+        debris restore() trips on; the resumed run continues the
+        uninterrupted trajectory."""
+        ref_mgr = _mgr(tmp_path, name="ref")
+        _, losses_all = run_training(_make_step(), _init_state(), 8,
+                                     manager=ref_mgr)
+        a, _ = _paired_stores()
+        mgr = _mgr(tmp_path, store=a, async_flush=True)
+        # The slow seam holds the step-2 flush's tmp open; preempt@3 then
+        # forces the synchronous save while that flush is in flight.
+        with chaos.chaos_scope("snap_slow~0.6;preempt@3"):
+            with pytest.raises(Preempted) as exc_info:
+                run_training(_make_step(), _init_state(), 8, manager=mgr,
+                             save_every=2, snapshot_every=1)
+        assert exc_info.value.step == 3
+        mgr.close()
+        assert mgr.latest_complete_step() == 3
+        _, meta = mgr.restore()  # nothing torn/uncommitted trips the scan
+        assert meta["step"] == 3
+        _, tail = run_training(_make_step(), _init_state(), 8, manager=mgr)
+        assert tail == losses_all[3:]
+
+    def test_gc_retention_step_keyed_not_mtime(self, tmp_path):
+        """Satellite: out-of-order flush commits must not evict the newest
+        STEP — retention keys on the step index, not mtime."""
+        mgr = _mgr(tmp_path, keep=2, async_flush=True)
+        mgr.save(_state(), 30)
+        # An older step commits AFTER step 30 (what a slow background flush
+        # looks like): its mtime is newer than step 30's.
+        mgr._flush_one(_snap(20))
+        assert os.path.getmtime(mgr._step_dir(20)) >= os.path.getmtime(
+            mgr._step_dir(30))
+        # A third out-of-order commit trips the keep=2 sweep: the smallest
+        # STEP goes — an mtime-ordered sweep would have evicted step 30
+        # (oldest mtime) and kept the two stale flushes.
+        mgr._flush_one(_snap(10))
+        assert mgr.steps_on_disk() == [20, 30]
+        assert mgr.latest_complete_step() == 30
+
+    def test_quarantine_retention_step_keyed(self, tmp_path):
+        mgr = _mgr(tmp_path, keep=1)
+        for step, age in ((10, 0.0), (30, 100.0)):
+            d = str(tmp_path / "ck" / f"step_{step:08d}.corrupt")
+            os.makedirs(d)
+            # Invert mtimes: the OLDER step looks newer on disk.
+            t = time.time() - age
+            os.utime(d, (t, t))
+        mgr.save(_state(), 40)
+        names = sorted(os.listdir(str(tmp_path / "ck")))
+        assert "step_00000030.corrupt" in names  # newest STEP survives
+        assert "step_00000010.corrupt" not in names
+
+
+# =============================================================================
+# Tiered restore
+# =============================================================================
+
+
+def _make_step():
+    import jax.numpy as jnp
+
+    def step(state):
+        p = state["p"]
+        p = p - 0.1 * (2.0 * p)
+        return {"p": p}, float(jnp.sum(p * p))
+
+    return step
+
+
+def _init_state():
+    import jax.numpy as jnp
+
+    return {"p": jnp.arange(8, dtype=jnp.float32)}
+
+
+class TestTieredRestore:
+    def _mgr_with_tiers(self, tmp_path):
+        a, b = _paired_stores()
+        mgr = _mgr(tmp_path, store=a, async_flush=True)
+        mgr.save(_state(0.0), 5)      # disk: oldest
+        mgr.snapshot(_state(1.0), 9)  # RAM: newest, in both local and peer
+        return mgr, a
+
+    def test_newest_valid_tier_wins(self, tmp_path):
+        log = str(tmp_path / "ev.jsonl")
+        mgr, store = self._mgr_with_tiers(tmp_path)
+        with obs_events.event_scope(obs_events.log_for_path(log)):
+            _, meta, tier, tried = elastic.tiered_restore(mgr)
+            assert (tier, meta["step"], tried) == ("local", 9, [])
+            # Local RAM gone (host lost it): the buddy replica serves.
+            store.drop_local()
+            _, meta, tier, _ = elastic.tiered_restore(mgr)
+            assert (tier, meta["step"]) == ("peer", 9)
+            # No RAM at all: disk.
+            store.buddy._replicas.clear()
+            state, meta, tier, _ = elastic.tiered_restore(mgr)
+            assert (tier, meta["step"]) == ("disk", 5)
+            assert np.allclose(np.asarray(state["p"]),
+                               np.arange(6, dtype=np.float32))
+        tiers = [(r["tier"], r["step"]) for r in _events(log)
+                 if r["kind"] == "restore" and r["ok"]]
+        assert tiers == [("local", 9), ("peer", 9), ("disk", 5)]
+
+    def test_checksum_fallthrough_ladder(self, tmp_path):
+        log = str(tmp_path / "ev.jsonl")
+        mgr, store = self._mgr_with_tiers(tmp_path)
+        with obs_events.event_scope(obs_events.log_for_path(log)):
+            with chaos.chaos_scope("snap_corrupt@local"):
+                _, meta, tier, tried = elastic.tiered_restore(mgr)
+            assert (tier, meta["step"]) == ("peer", 9)
+            assert tried == ["local@9"]
+            with chaos.chaos_scope("snap_corrupt@local,peer"):
+                # local@9 is already bad; this corrupts peer@9 (the newest
+                # still-valid RAM entry) — the ladder runs to disk.
+                _, meta, tier, tried = elastic.tiered_restore(mgr)
+            assert (tier, meta["step"]) == ("disk", 5)
+            assert tried == ["local@9", "peer@9"]
+        summary, _ = replay_events(log)
+        assert summary["restore_tiers"] == {"peer": 1, "disk": 1}
+        assert summary["restore_fallthroughs"] == 2
+        # Both corrupt injections correlate as recovered via the restores.
+        assert summary["unrecovered_faults"] == []
+
+    def test_all_tiers_exhausted_raises(self, tmp_path):
+        a, _ = _paired_stores()
+        mgr = _mgr(tmp_path, store=a, async_flush=True)
+        mgr.snapshot(_state(), 3)  # RAM only, then corrupted everywhere
+        a.corrupt_newest("local")
+        a.corrupt_newest("peer")
+        with pytest.raises(CheckpointRestoreError):
+            elastic.tiered_restore(mgr)
+        # elastic_resume keeps the pre-tier fresh-start semantics: invalid
+        # RAM counts as absent when disk never had a complete step.
+        state, start = elastic.elastic_resume(mgr, _state(9.0))
+        assert start == 0
+        assert np.allclose(np.asarray(state["p"]),
+                           np.arange(6, dtype=np.float32) + 9.0)
+        # ...but a COMPLETE disk step that fails to load still raises:
+        # corruption of real durable state must stay loud.
+        mgr.save(_state(), 5)
+        import shutil
+
+        for name in os.listdir(mgr._step_dir(5)):
+            if name != mgr.META:
+                p = os.path.join(mgr._step_dir(5), name)
+                shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
+        with pytest.raises(CheckpointRestoreError):
+            elastic.elastic_resume(mgr, _state())
+
+    def test_elastic_resume_names_tier(self, tmp_path):
+        log = str(tmp_path / "ev.jsonl")
+        mgr, _ = self._mgr_with_tiers(tmp_path)
+        with obs_events.event_scope(obs_events.log_for_path(log)):
+            state, start = elastic.elastic_resume(mgr, _state())
+        assert start == 9
+        ev = [r for r in _events(log) if r["kind"] == "elastic_resume"]
+        assert len(ev) == 1 and ev[0]["tier"] == "local"
+        # The schema now REQUIRES the tier on every elastic_resume.
+        summary, diags = replay_events(log)
+        assert not [d for d in diags if d.severity >= Severity.ERROR]
+
+    def test_elastic_resume_fresh_start_no_tiers(self, tmp_path):
+        log = str(tmp_path / "ev.jsonl")
+        a, _ = _paired_stores()
+        mgr = _mgr(tmp_path, store=a, async_flush=True)
+        with obs_events.event_scope(obs_events.log_for_path(log)):
+            state, start = elastic.elastic_resume(mgr, _state())
+        assert start == 0
+        assert not os.path.exists(log) or not [
+            r for r in _events(log) if r["kind"] in ("restore", "elastic_resume")]
+
+    def test_ram_restore_continues_trajectory(self, tmp_path):
+        """A RAM-tier resume reproduces the uninterrupted loss trajectory —
+        the same invariant PR 6 proved for disk, one tier up."""
+        ref = _mgr(tmp_path, name="ref")
+        _, losses_all = run_training(_make_step(), _init_state(), 8,
+                                     manager=ref)
+        a, _ = _paired_stores()
+        mgr = _mgr(tmp_path, store=a, async_flush=True)
+        # "Crash" after 5 steps; snapshots every step, disk every 4.
+        run_training(_make_step(), _init_state(), 5, manager=mgr,
+                     save_every=4, snapshot_every=1)
+        mgr.close()
+        state, start = elastic.elastic_resume(mgr, _init_state())
+        assert start == 4  # newest snapshot (done < n_steps cadence)
+        import jax
+
+        state = jax.tree_util.tree_map(
+            lambda x: jax.numpy.asarray(x), state)
+        _, tail = run_training(_make_step(), state, 8, manager=mgr,
+                               start_step=start)
+        assert tail == losses_all[4:]
+
+
+# =============================================================================
+# Replay correlation for the new seams/events
+# =============================================================================
+
+
+def _rec(kind, seq, **fields):
+    return {"v": 1, "ts": float(seq), "seq": seq, "kind": kind, **fields}
+
+
+class TestReplayContracts:
+    def _write(self, tmp_path, records):
+        p = str(tmp_path / "log.jsonl")
+        with open(p, "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+        return p
+
+    def test_snap_torn_unrecovered_flags(self, tmp_path):
+        p = self._write(tmp_path, [
+            _rec("fault_injected", 1, seam="snap_torn", target=None, n=1),
+            _rec("snapshot_flush", 2, step=4, ok=False, reason="torn"),
+        ])
+        summary, diags = replay_events(p)
+        assert summary["unrecovered_faults"] == ["snap_torn@None"]
+        # A later ok flush recovers it; a failed one must not.
+        p = self._write(tmp_path, [
+            _rec("fault_injected", 1, seam="snap_torn", target=None, n=1),
+            _rec("snapshot_flush", 2, step=4, ok=False, reason="torn"),
+            _rec("snapshot_flush", 3, step=6, ok=True),
+        ])
+        summary, _ = replay_events(p)
+        assert summary["unrecovered_faults"] == []
+
+    def test_snap_corrupt_recovered_by_restore_only(self, tmp_path):
+        p = self._write(tmp_path, [
+            _rec("fault_injected", 1, seam="snap_corrupt", target="local", n=1),
+            _rec("restore", 2, step=4, tier="local", ok=False),
+            _rec("snapshot_flush", 3, step=6, ok=True),
+        ])
+        summary, _ = replay_events(p)
+        assert summary["unrecovered_faults"] == ["snap_corrupt@local"]
+        p = self._write(tmp_path, [
+            _rec("fault_injected", 1, seam="snap_corrupt", target="local", n=1),
+            _rec("restore", 2, step=4, tier="local", ok=False),
+            _rec("restore", 3, step=4, tier="peer", ok=True,
+                 tried=["local@4"]),
+        ])
+        summary, _ = replay_events(p)
+        assert summary["unrecovered_faults"] == []
+        assert summary["restore_tiers"] == {"peer": 1}
+        assert summary["restore_fallthroughs"] == 1
+
+    def test_elastic_resume_requires_tier(self, tmp_path):
+        p = self._write(tmp_path, [
+            _rec("elastic_resume", 1, step=4, from_mesh=None, to_mesh=None,
+                 resharded=False),
+        ])
+        _, diags = replay_events(p)
+        missing = [d for d in diags if d.rule == "events.missing-fields"]
+        assert missing and "tier" in missing[0].message
+
+    def test_snapshot_stall_aggregation(self, tmp_path):
+        p = self._write(tmp_path, [
+            _rec("snapshot", 1, step=2, stall_ms=1.5),
+            _rec("snapshot", 2, step=4, stall_ms=2.5),
+        ])
+        summary, _ = replay_events(p)
+        assert summary["snapshots"] == 2
+        assert summary["snapshot_stall_ms_total"] == 4.0

@@ -445,6 +445,29 @@ WATCHDOG_UNGUARDED = REGISTRY.counter(
     "Guarded dispatches run UNguarded because the abandoned-worker cap "
     "(THUNDER_TPU_WATCHDOG_MAX_ABANDONED) was reached",
 )
+# Tiered checkpointing (ISSUE 14; docs/robustness.md "tiered
+# checkpointing"): the step-boundary snapshot stall (the only hot-path
+# cost), the background writer's disk commits, and the restore-tier ladder.
+SNAPSHOTS = REGISTRY.counter(
+    "thunder_tpu_snapshots_total",
+    "Step-boundary RAM snapshots taken (CheckpointManager.snapshot)",
+)
+CHECKPOINT_STALL_MS = REGISTRY.histogram(
+    "thunder_tpu_checkpoint_stall_ms",
+    "Milliseconds the training loop stalls per snapshot (device->host copy "
+    "+ crc32; disk durability runs on the background writer)",
+    buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0),
+)
+SNAPSHOT_FLUSHES = REGISTRY.counter(
+    "thunder_tpu_snapshot_flushes_total",
+    "Background/synchronous disk flushes of RAM snapshots, labelled "
+    "ok=true|false",
+)
+RESTORES = REGISTRY.counter(
+    "thunder_tpu_restores_total",
+    "Tiered checkpoint restores, labelled by winning tier "
+    "(local|peer|disk)",
+)
 # inc_always: a dropped observability sink must be visible even with the
 # metrics gate off — silent loss of the event log is the failure mode this
 # counter exists to expose (monitor.report() lists it unconditionally).

@@ -26,7 +26,13 @@ the runtime actually walk that ladder under fault:
   (cross-replica checksums, quarantine + re-run) — ISSUE 9;
 - :mod:`~thunder_tpu.resilience.elastic` — elastic resharded resume:
   restore a checkpoint written by one mesh shape onto a different
-  (smaller) mesh after a host loss — ISSUE 9;
+  (smaller) mesh after a host loss — ISSUE 9; restores are tiered (local
+  RAM → peer RAM → disk, ISSUE 14) via :func:`~thunder_tpu.resilience.
+  elastic.tiered_restore`;
+- :mod:`~thunder_tpu.resilience.snapshot` — the RAM checkpoint tiers:
+  per-host rings of step-boundary snapshots, crc32-validated and
+  replicated to a buddy host, fed by ``CheckpointManager.snapshot``'s
+  near-free device→host capture + background disk flush — ISSUE 14;
 - :mod:`~thunder_tpu.resilience.autopilot` — the fleet autopilot: the
   policy engine that decides WHICH of the above actuators to apply when
   faults arrive mixed and concurrent, with per-policy hysteresis and
@@ -62,7 +68,12 @@ from thunder_tpu.resilience.demotion import (  # noqa: F401
     quarantine_snapshot,
 )
 from thunder_tpu.resilience.deopt import NonFiniteOutputError  # noqa: F401
-from thunder_tpu.resilience.elastic import elastic_resume, reshard_state  # noqa: F401
+from thunder_tpu.resilience.elastic import (  # noqa: F401
+    elastic_resume,
+    reshard_state,
+    tiered_restore,
+)
+from thunder_tpu.resilience.snapshot import Snapshot, SnapshotStore  # noqa: F401
 from thunder_tpu.resilience.preemption import (  # noqa: F401
     CheckpointManager,
     CheckpointRestoreError,
@@ -89,7 +100,8 @@ __all__ = [
     "CheckpointRestoreError", "resume", "run_training",
     "Preempted", "HostLost",
     "CollectiveTimeoutError", "SDCDetectedError", "SDCGuard",
-    "elastic_resume", "reshard_state",
+    "elastic_resume", "reshard_state", "tiered_restore",
+    "Snapshot", "SnapshotStore",
     "Autopilot", "AutopilotHalt", "Policy", "Signal",
     "run_autopiloted_training",
 ]

@@ -448,6 +448,7 @@ def run_autopiloted_training(
     sdc_guard=True,
     watchdog_timeout_s: Optional[float] = None,
     save_every: int = 0,
+    snapshot_every: int = 0,
     on_step: Optional[Callable] = None,
     regrow_after: Optional[int] = None,
     max_recoveries: int = 32,
@@ -467,7 +468,12 @@ def run_autopiloted_training(
     ``regrow_after`` N healthy post-shrink steps reshard back up to the
     original mesh ("the replacement host arrived"). An anchor checkpoint is
     written up front so the first recovery always has something to resume
-    from. Returns ``(state, AutopilotReport)``; losses are indexed by step
+    from. ``snapshot_every`` forwards to
+    :func:`~.preemption.run_training`'s RAM-snapshot cadence (ISSUE 14):
+    with a :class:`~.snapshot.SnapshotStore` attached to ``manager``,
+    every ``elastic_resume`` here restores from the newest valid tier
+    (local RAM → peer RAM → disk) and its event names the tier. Returns
+    ``(state, AutopilotReport)``; losses are indexed by step
     (re-executed steps overwrite, so each step counts once)."""
     from thunder_tpu.resilience import elastic
     from thunder_tpu.resilience.preemption import (
@@ -541,7 +547,8 @@ def run_autopiloted_training(
                     step_fn, state, target,
                     manager=manager, mesh=cur_mesh, sdc_guard=sdc_guard,
                     watchdog_timeout_s=watchdog_timeout_s,
-                    save_every=save_every, on_loss=_on_loss,
+                    save_every=save_every, snapshot_every=snapshot_every,
+                    on_loss=_on_loss,
                     start_step=start,
                 )
                 if target >= n_steps:

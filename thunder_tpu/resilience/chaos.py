@@ -38,6 +38,17 @@ Seams and their typed errors:
                    data-parallel replica's shard of the training state
                    (recovery: the SDC replica-checksum guard quarantines
                    and re-runs the step, :class:`~.watchdog.SDCGuard`)
+``snap_torn``      torn write on the background checkpoint flush — the
+                   step directory lands WITHOUT its META commit marker
+                   (recovery: restore skips the incomplete step; the
+                   writer keeps flushing later steps)
+``snap_corrupt``   flips one bit in the newest RAM-tier snapshot
+                   (``@local`` / ``@peer`` / ``@local,peer``; recovery:
+                   the tiered restore's checksum gate falls through to
+                   the next tier, :mod:`~.snapshot`)
+``snap_slow``      slow background flush — sleeps ``~<delay>`` seconds
+                   inside the writer thread (recovery: the flush still
+                   commits; backpressure coalesces queued snapshots)
 =================  =====================================================
 
 Spec grammar (``THUNDER_TPU_CHAOS=<spec>`` or ``jit(chaos=<spec>)``)::
@@ -95,6 +106,7 @@ SEAMS = (
     "kernel_raise", "compile_fail", "compile_timeout", "oom", "nan",
     "straggler", "ckpt_io", "preempt", "cache_corrupt",
     "collective_hang", "host_loss", "sdc", "sched_bad",
+    "snap_torn", "snap_corrupt", "snap_slow",
 )
 
 
@@ -475,6 +487,56 @@ def checkpoint_seam() -> None:
         return
     if _should_fire("ckpt_io") is not None:
         raise InjectedCheckpointError()
+
+
+def flush_slow_seam() -> None:
+    """Background-flush seam (CheckpointManager's writer thread): an armed
+    ``snap_slow`` rule sleeps ``~<delay>`` seconds inside the flush — a
+    slow disk or contended network FS. The training loop must not stall
+    (the flush is off the hot path) and the single-in-flight backpressure
+    must coalesce snapshots queued behind the slow write instead of growing
+    an unbounded backlog."""
+    if active() is None:
+        return
+    rule = _should_fire("snap_slow")
+    if rule is not None:
+        time.sleep(rule.delay_s)
+
+
+def flush_torn_seam() -> bool:
+    """Background-flush seam: True when an armed ``snap_torn`` rule fires —
+    the flush must simulate a writer crash between the state write and the
+    META commit marker (a step directory in place WITHOUT its marker, the
+    torn write the commit protocol exists to catch). The restore path must
+    skip the incomplete step and fall through to the next tier/step."""
+    if active() is None:
+        return False
+    return _should_fire("snap_torn") is not None
+
+
+def snapshot_corrupt_seam(store) -> None:
+    """Restore-time seam (the tiered restore in ``resilience/elastic``):
+    an armed ``snap_corrupt`` rule flips one bit in the newest snapshot of
+    the targeted RAM tier — ``@local``, ``@peer`` (default), or
+    ``@local,peer`` for both — before the tiers are validated, so the
+    checksum gate must catch it and fall through. A rule that finds
+    nothing to corrupt (empty tier) stays armed rather than recording an
+    injection that never happened (the cache_corrupt discipline)."""
+    cfg = active()
+    if cfg is None or store is None:
+        return
+    for rule in cfg.rules_for("snap_corrupt"):
+        if rule.exhausted() or not rule.host_matches():
+            continue
+        tiers = [t.strip() for t in (rule.target or "peer").split(",")
+                 if t.strip() in ("local", "peer")] or ["peer"]
+        if rule.prob < 1.0 and cfg.rng.random() >= rule.prob:
+            continue
+        corrupted = [t for t in tiers if store.corrupt_newest(t)]
+        if not corrupted:
+            continue
+        rule.fired += 1
+        _record(rule, ",".join(corrupted))
 
 
 def preempt_at_step(step: int) -> bool:

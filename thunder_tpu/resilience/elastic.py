@@ -14,7 +14,13 @@ must continue on what survives instead of waiting for a replacement
   path in ``distributed/checkpoint.load(mesh=..., specs=...)`` reads only
   the byte ranges each surviving device needs at scale);
 - the caller rebuilds its step function for the surviving mesh
-  (``parallel.build_train_step``) and continues from the restored step.
+  (``parallel.build_train_step``) and continues from the restored step;
+- restores are TIERED (ISSUE 14): :func:`tiered_restore` picks the newest
+  valid state across local RAM → buddy-replicated peer RAM → disk
+  (``resilience/snapshot.SnapshotStore`` attached to the manager),
+  checksum-validating each tier and falling through on mismatch — the
+  common recovery is a host-memory read, not a disk round-trip, and every
+  ``elastic_resume`` event names its winning ``tier``.
 
 Numerics caveat (documented, asserted in tests): resharding is bitwise —
 gather + device_put never touches values — but the *continued run* on a
@@ -31,7 +37,11 @@ from typing import Any, Optional
 
 from thunder_tpu.observability import events as obs_events
 from thunder_tpu.observability import metrics as obsm
-from thunder_tpu.resilience.preemption import CheckpointManager
+from thunder_tpu.resilience import chaos
+from thunder_tpu.resilience.preemption import (
+    CheckpointManager,
+    CheckpointRestoreError,
+)
 
 
 def mesh_shape(mesh) -> Optional[dict]:
@@ -52,6 +62,81 @@ def reshard_state(state: Any, mesh, specs) -> Any:
     return reshard_pytree(state, mesh, specs)
 
 
+def tiered_restore(manager: CheckpointManager) -> tuple[Any, dict, str, list]:
+    """The tier ladder (ISSUE 14): pick the NEWEST valid state across
+    local RAM → peer RAM → disk, checksum-validating each RAM candidate and
+    falling through on mismatch/absence. Returns
+    ``(state, meta, tier, tried)`` where ``tier`` names the winning tier
+    and ``tried`` lists the ``tier@step`` candidates that failed
+    validation before it.
+
+    Candidates are ordered newest-step-first with the cheaper tier winning
+    ties (a local snapshot and its buddy replica carry the same step; the
+    local copy needs no fetch). Disk joins the ladder at its newest
+    complete step and uses :meth:`CheckpointManager.restore`'s own
+    incomplete/corrupt fall-through below that. The chaos ``snap_corrupt``
+    seam fires here — before validation — so a corrupted replica is
+    exactly what the checksum gate must catch. Every outcome is a
+    ``restore`` event (``tier``, ``ok``, ``tried``); raises
+    :class:`~thunder_tpu.resilience.preemption.CheckpointRestoreError` when
+    every tier is exhausted."""
+    store = getattr(manager, "store", None)
+    if hasattr(manager, "drain"):
+        # Quiesce the background writer before reading the directory: a
+        # restore racing an in-flight flush's rmtree/rename/GC could see a
+        # "complete" step vanish mid-scan. (The queued snapshot, if any,
+        # stays in RAM — it is one of the candidates below anyway.)
+        manager.drain()
+    chaos.snapshot_corrupt_seam(store)
+    candidates: list = []
+    if store is not None:
+        for snap in store.local_snapshots():
+            candidates.append((snap.step, 0, "local", snap))
+        for snap in store.peer_snapshots():
+            candidates.append((snap.step, 1, "peer", snap))
+    disk_step = manager.latest_complete_step()
+    if disk_step is not None:
+        candidates.append((disk_step, 2, "disk", None))
+    candidates.sort(key=lambda c: (-c[0], c[1]))
+    tried: list = []
+    for step, _, tier, snap in candidates:
+        if tier == "disk":
+            try:
+                state, meta = manager.restore()
+            except CheckpointRestoreError as e:
+                obs_events.emit_event(
+                    "restore", step=int(step), tier="disk", ok=False,
+                    tried=list(tried), reason=str(e),
+                )
+                tried.append(f"disk@{step}")
+                continue
+        else:
+            if not snap.verify():
+                # The SDC-guard crc caught a rotted/corrupted snapshot:
+                # fall through to the next tier instead of resuming from
+                # poison (the snap_corrupt chaos seam's recovery).
+                obs_events.emit_event(
+                    "restore", step=int(step), tier=tier, ok=False,
+                    reason="checksum mismatch",
+                )
+                tried.append(f"{tier}@{step}")
+                continue
+            state = snap.state
+            meta = {"step": snap.step, "rng_seed": snap.rng_seed,
+                    "mesh": snap.mesh}
+        obs_events.emit_event(
+            "restore", step=int(meta["step"]), tier=tier, ok=True,
+            tried=list(tried),
+        )
+        if obsm.enabled():
+            obsm.RESTORES.inc(tier=tier)
+        return state, meta, tier, tried
+    raise CheckpointRestoreError(
+        f"no valid state in any tier under {manager.directory!r} "
+        f"(tried {tried or 'nothing'})"
+    )
+
+
 def elastic_resume(
     manager: CheckpointManager,
     init_state: Any,
@@ -65,23 +150,49 @@ def elastic_resume(
     matching the state structure) even when the checkpoint was written by a
     different mesh shape — the surviving-devices path after a host loss.
 
-    Emits an ``elastic_resume`` event recording the saved → target shape
-    and bumps ``thunder_tpu_elastic_resumes_total`` when an actual reshard
-    happened. With no checkpoint on disk, returns ``(init_state, 0)``
-    (``init_state`` is resharded too when it isn't already laid out on
-    ``mesh`` — a fresh elastic start is just a reshard from nothing)."""
-    if manager.latest_complete_step() is None:
+    The restore is TIERED (:func:`tiered_restore`): the newest valid state
+    wins across local RAM → peer RAM → disk, so an in-process recovery is
+    a host-memory read instead of a disk round-trip and loses at most the
+    snapshot cadence of steps. The ``elastic_resume`` event names the
+    winning ``tier`` (the ISSUE 14 acceptance invariant) alongside the
+    saved → target shape; ``thunder_tpu_elastic_resumes_total`` bumps when
+    an actual reshard happened. Fresh-start semantics match the pre-tier
+    behavior: with no COMPLETE disk step and nothing VALID in RAM, returns
+    ``(init_state, 0)`` (``init_state`` is resharded too when it isn't
+    already laid out on ``mesh`` — a fresh elastic start is just a reshard
+    from nothing; invalid RAM snapshots count as absent here), while a
+    disk step that exists but fails to load still raises — corruption of a
+    real checkpoint must stay loud."""
+    def _fresh_start():
+        nonlocal init_state
         if mesh is not None and specs is not None:
             init_state = reshard_state(init_state, mesh, specs)
         return init_state, 0
 
-    state, meta = manager.restore()
+    store = getattr(manager, "store", None)
+    # Captured BEFORE the restore attempt: a failing disk restore
+    # quarantines the steps it rejects, so asking afterwards would make
+    # corrupted-durable-state indistinguishable from never-had-any.
+    had_disk = manager.latest_complete_step() is not None
+    if not had_disk and not (store is not None and store.has_snapshots()):
+        return _fresh_start()
+
+    try:
+        state, meta, tier, _tried = tiered_restore(manager)
+    except CheckpointRestoreError:
+        if not had_disk:
+            # Every RAM candidate failed its checksum and disk never had a
+            # complete step: a run that has not yet committed anything
+            # durable starts over cleanly instead of dying mid-recovery.
+            return _fresh_start()
+        raise
     saved_shape = meta.get("mesh")
     target_shape = mesh_shape(mesh)
     resharded = False
     if mesh is not None and specs is not None:
-        # Restored leaves are host arrays (pickle fallback) or arrays on the
-        # saving mesh (Orbax) — either way, land them on the target layout.
+        # Restored leaves are host arrays (RAM snapshots, pickle fallback)
+        # or arrays on the saving mesh (Orbax) — either way, land them on
+        # the target layout.
         state = reshard_state(state, mesh, specs)
         resharded = saved_shape is not None and saved_shape != target_shape
     obs_events.emit_event(
@@ -90,6 +201,7 @@ def elastic_resume(
         from_mesh=saved_shape,
         to_mesh=target_shape,
         resharded=resharded,
+        tier=tier,
     )
     if resharded and obsm.enabled():
         obsm.ELASTIC_RESUMES.inc()

@@ -18,6 +18,15 @@ transient I/O errors), and a fresh process must resume from the newest
   optimizer state), run, checkpoint on preemption or cadence. A resumed
   run reproduces the uninterrupted loss trajectory bitwise
   (tests/test_resilience.py proves it).
+
+Tiered checkpointing (ISSUE 14): with a
+:class:`~thunder_tpu.resilience.snapshot.SnapshotStore` attached and
+``async_flush=True``, :meth:`CheckpointManager.snapshot` makes saving
+near-free (the hot path pays only the device→host copy, measured as
+``checkpoint_stall_ms``; disk durability runs on a background writer
+thread) and the tiered restore in :mod:`~thunder_tpu.resilience.elastic`
+makes restoring fast (local RAM → buddy-replicated peer RAM → disk,
+checksum-validated per tier). docs/robustness.md "tiered checkpointing".
 """
 
 from __future__ import annotations
@@ -26,6 +35,7 @@ import json
 import os
 import shutil
 import signal
+import threading
 import time
 from typing import Any, Callable, Optional
 
@@ -108,6 +118,18 @@ def _multihost_all(local_ok: bool) -> bool:
     except Exception:
         pass
     return local_ok
+
+
+def _multi_process() -> bool:
+    """True on a real multi-process fleet (an initialized jax backend with
+    process_count > 1). Used to keep the async checkpoint writer off the
+    multi-host commit path — see :meth:`CheckpointManager.snapshot`."""
+    try:
+        import jax
+
+        return jax.process_count() > 1
+    except Exception:
+        return False
 
 
 def _multihost_any(local: bool) -> bool:
@@ -198,16 +220,42 @@ class CheckpointManager:
     ``META.json`` commit marker written LAST — a directory without META is
     incomplete (crashed mid-write) and is ignored (and swept) on restore.
     Saves go to a ``.tmp`` path first and are renamed into place, so a
-    crash can never tear a committed step."""
+    crash can never tear a committed step.
+
+    Tiered checkpointing (ISSUE 14): ``store`` attaches a RAM
+    :class:`~thunder_tpu.resilience.snapshot.SnapshotStore` (local ring +
+    buddy replica — the fast restore tiers the elastic resume tries before
+    disk), and ``async_flush=True`` moves disk durability onto a background
+    writer thread: :meth:`snapshot` pays only the device→host copy on the
+    hot path (the measured ``checkpoint_stall_ms``) and enqueues the
+    tmp→rename→META protocol for the writer, single-in-flight with
+    latest-wins backpressure (a newer snapshot supersedes a still-queued
+    older one; the superseded one stays restorable in RAM). :meth:`save`
+    stays fully synchronous — the preempt/halt path — and drains the
+    writer first so two commits never interleave on the directory."""
 
     META = "META.json"
 
     def __init__(self, directory: str, *, retries: int = 3,
-                 backoff_s: float = 0.1, keep: int = 3):
+                 backoff_s: float = 0.1, keep: int = 3,
+                 store=None, async_flush: bool = False):
         self.directory = os.path.abspath(directory)
         self.retries = int(retries)
         self.backoff_s = float(backoff_s)
         self.keep = int(keep)
+        self.store = store
+        self.async_flush = bool(async_flush)
+        # Background-writer state: one flush in flight, at most one pending
+        # (latest wins), a writer thread started lazily, and an IO lock so
+        # the writer's commit and a synchronous save never interleave the
+        # tmp/rename/GC protocol on the same directory.
+        self._io_lock = threading.Lock()
+        self._flush_cv = threading.Condition()
+        self._pending: Optional[tuple] = None  # (Snapshot, Context)
+        self._inflight_step: Optional[int] = None
+        self._coalesced = 0
+        self._writer: Optional[threading.Thread] = None
+        self._stop = False
         os.makedirs(self.directory, exist_ok=True)
 
     # -- paths ----------------------------------------------------------------
@@ -240,10 +288,101 @@ class CheckpointManager:
 
     # -- save -----------------------------------------------------------------
 
+    @staticmethod
+    def _mesh_meta(mesh) -> Optional[dict]:
+        if mesh is None:
+            return None
+        if isinstance(mesh, dict):
+            return {str(k): int(v) for k, v in mesh.items()}
+        from thunder_tpu.parallel.mesh import axis_sizes
+
+        return axis_sizes(mesh)
+
+    def _write_attempts(self, state: Any, step: int, *,
+                        rng_seed: Optional[int], mesh_meta: Optional[dict],
+                        flush_seams: bool = False,
+                        ) -> tuple[Optional[OSError], int, bool]:
+        """The tmp-write → atomic-rename → META-commit loop with
+        retry/backoff — shared by the synchronous :meth:`save` and the
+        background flush. Returns ``(terminal_error, attempts, torn)``;
+        ``torn`` (flush path only, the ``snap_torn`` chaos seam) means the
+        step directory landed WITHOUT its commit marker — the simulated
+        writer-crash shape :meth:`restore` must skip."""
+        final = self._step_dir(step)
+        primary = _is_primary()
+        attempt = 0
+        while True:
+            tmp = final + ".tmp"
+            try:
+                chaos.checkpoint_seam()
+                with self._io_lock:
+                    if primary and os.path.isdir(tmp):
+                        shutil.rmtree(tmp)
+                    self._write_state(state, tmp)
+                    if flush_seams:
+                        # The juicy window: tmp written, nothing committed.
+                        # snap_slow holds it open (a slow disk with an
+                        # uncommitted tmp on it); snap_torn "crashes" here.
+                        chaos.flush_slow_seam()
+                        if chaos.flush_torn_seam():
+                            # A real crash between the state write and the
+                            # META marker can never destroy an already-
+                            # committed dir at this step — rename into
+                            # place only when the slot is empty, else the
+                            # torn shape is just the orphaned .tmp.
+                            if primary and not os.path.isdir(final):
+                                os.rename(tmp, final)
+                            return None, attempt, True
+                    if primary:
+                        meta = {
+                            "step": int(step),
+                            "rng_seed": int(rng_seed) if rng_seed is not None else None,
+                            "mesh": mesh_meta,
+                            "ts": time.time(),
+                        }
+                        with open(os.path.join(tmp, self.META), "w") as f:
+                            json.dump(meta, f)
+                        if os.path.isdir(final):
+                            shutil.rmtree(final)
+                        os.rename(tmp, final)
+                return None, attempt, False
+            except OSError as e:
+                obs_events.emit_event(
+                    "checkpoint_save", path=final, step=int(step), ok=False,
+                    attempt=attempt, error=str(e),
+                )
+                if attempt >= self.retries:
+                    return e, attempt, False
+                if obsm.enabled():
+                    obsm.CHECKPOINT_RETRIES.inc()
+                if self.backoff_s:
+                    time.sleep(min(self.backoff_s * (2 ** attempt), 2.0))
+                attempt += 1
+
+    def _committed(self, step: int, attempt: int) -> str:
+        """Post-commit bookkeeping shared by save and flush: the ok
+        ``checkpoint_save`` record (the recovery event the ckpt_io/preempt
+        correlation rules key on) and the primary-only retention sweep."""
+        final = self._step_dir(step)
+        obs_events.emit_event(
+            "checkpoint_save", path=final, step=int(step), ok=True,
+            attempt=attempt,
+        )
+        if _is_primary():
+            self._gc()
+        return final
+
     def save(self, state: Any, step: int, *, rng_seed: Optional[int] = None,
              mesh=None) -> str:
-        """Write ``state`` for ``step`` with retry/backoff on transient I/O
-        errors. Returns the committed directory path.
+        """Write ``state`` for ``step`` SYNCHRONOUSLY with retry/backoff on
+        transient I/O errors; returns the committed directory path. This is
+        the durability barrier: the preempt/halt/host-loss paths call it
+        and must not return until the step is on disk.
+
+        With the async writer armed, the in-flight background flush is
+        drained first and any still-queued older snapshot is discarded —
+        this newer synchronous commit supersedes it (the superseded
+        snapshot remains restorable from the RAM tiers).
 
         ``mesh`` (a ``jax.sharding.Mesh`` or an ``{axis: size}`` dict)
         records the mesh SHAPE that wrote the checkpoint in the META commit
@@ -256,55 +395,10 @@ class CheckpointManager:
         step into place, and runs retention sweeps; the other hosts barrier
         on the commit — two hosts racing the rename/GC is the
         double-write/partial-retention hazard this closes."""
-        final = self._step_dir(step)
-        primary = _is_primary()
-        mesh_meta = None
-        if mesh is not None:
-            if isinstance(mesh, dict):
-                mesh_meta = {str(k): int(v) for k, v in mesh.items()}
-            else:
-                from thunder_tpu.parallel.mesh import axis_sizes
-
-                mesh_meta = axis_sizes(mesh)
-        attempt = 0
-        terminal: Optional[OSError] = None
-        while True:
-            tmp = final + ".tmp"
-            try:
-                chaos.checkpoint_seam()
-                if primary and os.path.isdir(tmp):
-                    shutil.rmtree(tmp)
-                self._write_state(state, tmp)
-                if primary:
-                    meta = {
-                        "step": int(step),
-                        "rng_seed": int(rng_seed) if rng_seed is not None else None,
-                        "mesh": mesh_meta,
-                        "ts": time.time(),
-                    }
-                    with open(os.path.join(tmp, self.META), "w") as f:
-                        json.dump(meta, f)
-                    if os.path.isdir(final):
-                        shutil.rmtree(final)
-                    os.rename(tmp, final)
-                break
-            except OSError as e:
-                obs_events.emit_event(
-                    "checkpoint_save", path=final, step=int(step), ok=False,
-                    attempt=attempt, error=str(e),
-                )
-                if attempt >= self.retries:
-                    # Terminal — but this host must still reach the commit
-                    # sync below: raising here would strand every peer in
-                    # the agreement collective (a failed save must not
-                    # become a mesh-wide hang).
-                    terminal = e
-                    break
-                if obsm.enabled():
-                    obsm.CHECKPOINT_RETRIES.inc()
-                if self.backoff_s:
-                    time.sleep(min(self.backoff_s * (2 ** attempt), 2.0))
-                attempt += 1
+        self._drain(discard_pending=True)
+        terminal, attempt, _ = self._write_attempts(
+            state, step, rng_seed=rng_seed, mesh_meta=self._mesh_meta(mesh),
+        )
         # Commit sync: every host reports its terminal status and learns the
         # fleet's. Non-primary hosts both wait for the primary's META/rename
         # to land AND find out whether it did — a step is durable only when
@@ -320,13 +414,192 @@ class CheckpointManager:
                 f"checkpoint save for step {step} failed on a peer host — "
                 f"the step was not committed"
             )
-        obs_events.emit_event(
-            "checkpoint_save", path=final, step=int(step), ok=True,
-            attempt=attempt,
+        return self._committed(step, attempt)
+
+    # -- the async tier: snapshot + background flush ---------------------------
+
+    def snapshot(self, state: Any, step: int, *,
+                 rng_seed: Optional[int] = None, mesh=None,
+                 flush: bool = False):
+        """Step-boundary snapshot: device→host copy + crc32 — the ONLY work
+        on the training hot path, measured and emitted as the ``snapshot``
+        event's ``stall_ms``. The snapshot lands in the RAM tiers (local
+        ring + buddy replica via ``self.store``) immediately; with
+        ``flush=True`` it is also queued for the background writer's disk
+        commit (single in-flight; a newer queued snapshot replaces an older
+        one that has not started writing — latest-wins backpressure, so a
+        slow disk can never grow a backlog). Returns the
+        :class:`~thunder_tpu.resilience.snapshot.Snapshot`."""
+        from thunder_tpu.resilience import snapshot as snap_mod
+
+        t0 = time.perf_counter()
+        host_state = snap_mod.to_host(state)
+        crcs = snap_mod.pytree_crc32(host_state)
+        stall_ms = (time.perf_counter() - t0) * 1e3
+        snap = snap_mod.Snapshot(
+            step=int(step), state=host_state,
+            rng_seed=int(rng_seed) if rng_seed is not None else None,
+            mesh=self._mesh_meta(mesh), crcs=crcs,
         )
-        if primary:
-            self._gc()
-        return final
+        replicated = self.store.put(snap) if self.store is not None else False
+        if obsm.enabled():
+            obsm.SNAPSHOTS.inc()
+            obsm.CHECKPOINT_STALL_MS.observe(stall_ms)
+        obs_events.emit_event(
+            "snapshot", step=int(step), stall_ms=round(stall_ms, 3),
+            replicated=replicated,
+            ring=len(self.store.local_snapshots()) if self.store is not None else 0,
+        )
+        if flush:
+            if _multi_process():
+                # The background writer is HOST-LOCAL: its latest-wins
+                # coalescing can leave different hosts flushing different
+                # steps, and the Orbax save runs global sync barriers — a
+                # skewed fleet would deadlock, and a primary-side META
+                # commit could land without knowing whether peers finished
+                # their shard writes. On a real multi-process fleet the
+                # disk cadence therefore stays on the synchronous save()
+                # protocol (commit barrier included); the RAM tiers above
+                # still provide the cheap snapshots and fast restores.
+                self.save(snap.state, snap.step, rng_seed=snap.rng_seed,
+                          mesh=snap.mesh)
+            else:
+                self._enqueue_flush(snap)
+        return snap
+
+    def _enqueue_flush(self, snap) -> None:
+        import contextvars
+
+        # The writer must run each flush under the SUBMITTER's context:
+        # chaos scopes and event-log routing are contextvars and a plain
+        # thread starts from an empty context — the same fix as the PR 9
+        # watchdog worker, snapshotted per flush so a scope entered after
+        # the writer thread started still reaches its seams.
+        ctx = contextvars.copy_context()
+        with self._flush_cv:
+            if self._pending is not None:
+                self._coalesced += 1
+            self._pending = (snap, ctx)
+            if self._writer is None or not self._writer.is_alive():
+                self._stop = False
+                self._writer = threading.Thread(
+                    target=self._writer_loop, name="thunder-tpu-ckpt-writer",
+                    daemon=True,
+                )
+                self._writer.start()
+            self._flush_cv.notify_all()
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._flush_cv:
+                while self._pending is None and not self._stop:
+                    self._flush_cv.wait()
+                if self._pending is None:
+                    return
+                snap, ctx = self._pending
+                self._pending = None
+                self._inflight_step = snap.step
+                coalesced, self._coalesced = self._coalesced, 0
+            try:
+                ctx.run(self._flush_one, snap, coalesced=coalesced)
+            except BaseException:
+                # The flush reports via its events; the writer itself must
+                # survive anything — a dead writer would silently end disk
+                # durability for the rest of the run.
+                pass
+            finally:
+                with self._flush_cv:
+                    self._inflight_step = None
+                    self._flush_cv.notify_all()
+
+    def _flush_one(self, snap, *, coalesced: int = 0, sync: bool = False) -> None:
+        """Commit one snapshot to disk (writer thread, or the caller's
+        thread for the synchronous ``flush()``), reporting the outcome as a
+        ``snapshot_flush`` event. Never raises: a flush that exhausts its
+        retries leaves the RAM tiers holding the snapshot and the next
+        synchronous save to fail loudly."""
+        t0 = time.perf_counter()
+        reason = None
+        try:
+            terminal, attempt, torn = self._write_attempts(
+                snap.state, snap.step, rng_seed=snap.rng_seed,
+                mesh_meta=snap.mesh, flush_seams=True,
+            )
+            ok = terminal is None and not torn
+            if torn:
+                reason = "torn"
+            elif terminal is not None:
+                reason = f"retries exhausted: {terminal}"
+        except Exception as e:  # a commit bug must not kill the writer
+            ok, attempt = False, 0
+            reason = str(e)
+        ms = (time.perf_counter() - t0) * 1e3
+        if obsm.enabled():
+            obsm.SNAPSHOT_FLUSHES.inc(ok=str(ok).lower())
+        extra: dict = {}
+        if reason:
+            extra["reason"] = reason
+        if coalesced:
+            extra["coalesced"] = coalesced
+        obs_events.emit_event(
+            "snapshot_flush", step=int(snap.step), ok=ok,
+            ms=round(ms, 3), sync=sync, **extra,
+        )
+        if ok:
+            self._committed(snap.step, attempt)
+
+    def drain(self) -> None:
+        """Public quiesce point: wait until the writer is fully idle —
+        both the in-flight flush AND any queued-but-unstarted one have
+        completed (a pending flush the writer dequeues a moment after a
+        weaker drain returned would race the directory scan all the
+        same). The tiered restore calls this before reading the
+        directory — a restore racing the writer's rmtree/rename/GC could
+        see a step vanish mid-scan."""
+        with self._flush_cv:
+            while (self._inflight_step is not None
+                   or self._pending is not None):
+                self._flush_cv.wait()
+
+    def _drain(self, *, discard_pending: bool = False) -> None:
+        """Wait out the in-flight background flush (and optionally drop the
+        queued one) — the preamble every synchronous commit runs so two
+        writers never interleave on the directory."""
+        with self._flush_cv:
+            if discard_pending:
+                self._pending = None
+                self._coalesced = 0
+            while self._inflight_step is not None:
+                self._flush_cv.wait()
+
+    def flush(self, *, wait: bool = True) -> None:
+        """Synchronous flush barrier (the preempt/halt path and tests):
+        wait out the in-flight background write, then commit any
+        still-pending snapshot on the CALLER's thread (its
+        ``snapshot_flush`` event carries ``sync=true``)."""
+        pending = None
+        coalesced = 0
+        with self._flush_cv:
+            while wait and self._inflight_step is not None:
+                self._flush_cv.wait()
+            if self._pending is not None:
+                pending, _ctx = self._pending
+                self._pending = None
+                coalesced, self._coalesced = self._coalesced, 0
+        if pending is not None:
+            self._flush_one(pending, coalesced=coalesced, sync=True)
+
+    def close(self) -> None:
+        """Flush and stop the background writer (tests and orderly
+        shutdown; production relies on the daemon flag plus the synchronous
+        preempt-path :meth:`save`)."""
+        self.flush(wait=True)
+        with self._flush_cv:
+            self._stop = True
+            self._flush_cv.notify_all()
+        w = self._writer
+        if w is not None and w.is_alive():
+            w.join(timeout=5.0)
 
     def _write_state(self, state: Any, tmp_dir: str) -> None:
         # distributed/checkpoint.save: Orbax sharded save, or the host-local
@@ -349,7 +622,11 @@ class CheckpointManager:
 
     def _quarantined_on_disk(self) -> list[str]:
         """Quarantined checkpoint dirs (``step_*.corrupt`` /
-        ``step_*.corrupt.N``), oldest first by mtime."""
+        ``step_*.corrupt.N``), oldest first by the STEP INDEX parsed from
+        the name — NOT by mtime: the async writer commits out of order
+        relative to synchronous saves, so mtime lies about age and an
+        mtime-keyed sweep could evict the newest quarantine (ISSUE 14
+        satellite). mtime only tiebreaks repeat quarantines of one step."""
         out = []
         try:
             names = os.listdir(self.directory)
@@ -358,16 +635,51 @@ class CheckpointManager:
         for name in names:
             if name.startswith("step_") and ".corrupt" in name:
                 path = os.path.join(self.directory, name)
+                stem = name[len("step_"):].split(".corrupt", 1)[0]
                 try:
-                    out.append((os.path.getmtime(path), path))
+                    step = int(stem)
+                except ValueError:
+                    step = -1
+                try:
+                    out.append((step, os.path.getmtime(path), path))
                 except OSError:
                     continue
-        return [p for _, p in sorted(out)]
+        return [p for _, _, p in sorted(out)]
 
     def _gc(self) -> None:
+        # Retention is keyed on the STEP INDEX (steps_on_disk sorts
+        # numerically), never mtime: a slow background flush of step N can
+        # commit AFTER the synchronous save of step N+k, and an
+        # mtime-ordered sweep would then evict the newest checkpoint while
+        # keeping the stale flush (ISSUE 14 satellite). restore()'s
+        # newest-first scan walks the same step order.
         steps = [s for s in self.steps_on_disk() if self._is_complete(s)]
         for s in steps[:-self.keep] if self.keep > 0 else []:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        # Write debris: an incomplete step dir (torn write — renamed into
+        # place without META) or an orphaned .tmp older than the newest
+        # complete step can never become complete (its writer moved on);
+        # sweeping keeps restore()'s scan short and the directory bounded
+        # under a chaos soak full of torn flushes. Primary-only, like the
+        # rest of the sweep.
+        if steps:
+            newest = steps[-1]
+            for s in self.steps_on_disk():
+                if s < newest and not self._is_complete(s):
+                    shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            try:
+                names = os.listdir(self.directory)
+            except OSError:
+                names = []
+            for name in names:
+                if name.startswith("step_") and name.endswith(".tmp"):
+                    try:
+                        s = int(name[len("step_"):-len(".tmp")])
+                    except ValueError:
+                        continue
+                    if s < newest:
+                        shutil.rmtree(os.path.join(self.directory, name),
+                                      ignore_errors=True)
         # Quarantined (.corrupt/.corrupt.N) dirs fold into the same bounded
         # retention: repeated corruption under a long soak previously grew
         # the directory without limit because the sweep only ever looked at
@@ -414,7 +726,14 @@ class CheckpointManager:
                 while os.path.exists(target):
                     target = f"{step_dir}.corrupt.{n}"
                     n += 1
-                os.rename(step_dir, target)
+                try:
+                    os.rename(step_dir, target)
+                except OSError:
+                    # The dir mutated under us (a writer re-committing or a
+                    # GC sweep): the fall-through below is still correct —
+                    # a restore must degrade, never crash on directory
+                    # churn.
+                    pass
                 tried.append(step)
                 continue
             obs_events.emit_event(
@@ -450,6 +769,7 @@ def run_training(
     manager: CheckpointManager,
     guard: Optional[PreemptionGuard] = None,
     save_every: int = 0,
+    snapshot_every: int = 0,
     on_loss: Optional[Callable] = None,
     mesh=None,
     sdc_guard=None,
@@ -464,6 +784,15 @@ def run_training(
     preemption is requested, saves and raises :class:`Preempted`;
     ``save_every > 0`` also checkpoints on that cadence. Returns
     ``(final_state, losses_this_run)``.
+
+    Tiered checkpointing (ISSUE 14): ``snapshot_every > 0`` takes a
+    near-free RAM snapshot (``manager.snapshot`` — device→host copy only)
+    on that cadence, so a fault loses at most ``snapshot_every`` steps
+    instead of ``save_every``; when the manager's async writer is armed
+    (``CheckpointManager(async_flush=True)``) the ``save_every`` disk
+    cadence rides the background flush instead of stalling the loop (the
+    preempt/host-loss saves below stay synchronous — they are the
+    durability barrier).
 
     Mesh-wide resilience (ISSUE 9):
 
@@ -576,10 +905,27 @@ def run_training(
             if on_loss is not None:
                 on_loss(step, loss)
             done = step + 1
-            if save_every and done % save_every == 0 and done < n_steps:
-                manager.save(
-                    state, done, rng_seed=api._global_rng["seed"], mesh=mesh
-                )
+            if done < n_steps:
+                want_disk = bool(save_every and done % save_every == 0)
+                want_snap = bool(snapshot_every and done % snapshot_every == 0)
+                if (want_disk or want_snap) and getattr(manager, "async_flush", False):
+                    # Tiered path: the hot loop pays only the device→host
+                    # snapshot; the disk cadence rides the background writer.
+                    manager.snapshot(
+                        state, done, rng_seed=api._global_rng["seed"],
+                        mesh=mesh, flush=want_disk,
+                    )
+                else:
+                    if want_snap and hasattr(manager, "snapshot"):
+                        manager.snapshot(
+                            state, done, rng_seed=api._global_rng["seed"],
+                            mesh=mesh,
+                        )
+                    if want_disk:
+                        manager.save(
+                            state, done, rng_seed=api._global_rng["seed"],
+                            mesh=mesh,
+                        )
         return state, losses
     finally:
         if own_guard:

@@ -1,0 +1,213 @@
+"""Tiered snapshot store: RAM ring + buddy-replicated restore tiers.
+
+The disk checkpoint (``resilience/preemption.CheckpointManager``) is
+durable but expensive: a synchronous save gathers the state to host AND
+pays the serialize/write/rename protocol on the hot path, so PR 11's soak
+could only afford sparse anchors — and every fault lost up to
+``save_every`` steps of progress plus a disk read on restore
+(``SOAK_r01``: 3.61 s charged per fault). ISSUE 14 splits the cost:
+
+- the **step-boundary stall** is only the device→host copy (plus a crc32
+  over the host bytes) — a :class:`Snapshot`, measured and emitted as the
+  ``snapshot`` event's ``stall_ms``;
+- durability moves to a background writer thread inside
+  ``CheckpointManager`` (the existing tmp→rename→META protocol, off the
+  hot path);
+- availability comes from RAM: each host keeps a small ring of recent
+  snapshots (:class:`SnapshotStore`) and replicates every snapshot to a
+  **buddy** host, so the tiered restore
+  (``resilience/elastic.elastic_resume``) can try local RAM → peer RAM →
+  disk, checksum-validating each tier and falling through on
+  mismatch/absence.
+
+Integrity reuses the SDC guard's checksum
+(:func:`~thunder_tpu.resilience.watchdog.array_crc32`): every snapshot
+records per-leaf crc32s at capture time and :meth:`Snapshot.verify`
+recomputes them before a restore trusts the bytes — a corrupted replica
+(chaos seam ``snap_corrupt``) degrades to the next tier instead of
+resuming from poison.
+
+On a real multi-host fleet ``replicate`` would ship shard bytes to the
+buddy over the network; on the virtual 8-device mesh the buddy is another
+in-process :class:`SnapshotStore` (the soak wires a pair), which keeps the
+tier ladder — and every chaos seam along it — exercisable in CI.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+TIERS = ("local", "peer", "disk")
+
+
+def to_host(state: Any) -> Any:
+    """Device→host copy of a pytree of (possibly sharded) arrays — the ONLY
+    work on the training hot path (the ``checkpoint_stall_ms`` the
+    ``snapshot`` event measures). Multi-process sharded leaves allgather
+    (``distributed/checkpoint.gather_full``); everything else is a
+    ``device_get``."""
+    from thunder_tpu.distributed.checkpoint import gather_full
+
+    return gather_full(state)
+
+
+def pytree_crc32(host_state: Any) -> tuple:
+    """Per-array-leaf crc32s of a host pytree, in flatten order — the SDC
+    guard's integrity code (``watchdog.array_crc32``) applied to a
+    snapshot. Non-array leaves (step counters, python scalars) are skipped:
+    they travel in the snapshot but are not checksummed."""
+    import numpy as np
+
+    from thunder_tpu.core.pytree import tree_flatten
+    from thunder_tpu.resilience.watchdog import array_crc32
+
+    flat, _ = tree_flatten(host_state)
+    out = []
+    for leaf in flat:
+        if isinstance(leaf, np.ndarray) and leaf.size:
+            out.append(array_crc32(leaf))
+    return tuple(out)
+
+
+@dataclass
+class Snapshot:
+    """One step-boundary capture: the host-side state plus everything a
+    restore needs (rng stream, writing mesh shape) and the capture-time
+    crc32s that let a later restore verify the bytes are still the bytes."""
+
+    step: int
+    state: Any
+    rng_seed: Optional[int] = None
+    mesh: Optional[dict] = None
+    crcs: tuple = ()
+    ts: float = field(default_factory=time.time)
+
+    def verify(self) -> bool:
+        """True iff the state's array bytes still match the capture-time
+        checksums — the gate every RAM-tier restore passes through."""
+        return pytree_crc32(self.state) == self.crcs
+
+    def share(self) -> "Snapshot":
+        """A new Snapshot sharing the underlying arrays — what replication
+        hands the buddy. Sharing is safe because corruption (the chaos
+        seam) is copy-on-write: :meth:`SnapshotStore.corrupt_newest`
+        replaces the flipped leaf instead of mutating it in place, so one
+        tier's corruption never bleeds into the other's copy."""
+        return Snapshot(step=self.step, state=self.state,
+                        rng_seed=self.rng_seed, mesh=self.mesh,
+                        crcs=self.crcs, ts=self.ts)
+
+
+class SnapshotStore:
+    """Per-host ring of recent snapshots plus replicas held for buddies.
+
+    ``put`` appends to the local ring (bounded: ``ring`` newest kept) and
+    forwards a shared-array copy to the paired buddy, which files it under
+    this host's id. The tiered restore reads ``local_snapshots()`` (own
+    ring) and ``peer_snapshots()`` (this host's replicas as held BY the
+    buddy — where a replacement process would fetch them from after losing
+    its RAM), both newest-first."""
+
+    def __init__(self, host: int = 0, *, ring: int = 4):
+        self.host = int(host)
+        self.ring = int(ring)
+        self._ring: deque = deque(maxlen=self.ring)
+        self._replicas: dict[int, deque] = {}  # origin host -> ring of copies
+        self.buddy: Optional["SnapshotStore"] = None
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def pair(a: "SnapshotStore", b: "SnapshotStore") -> None:
+        """Mutual buddies — the 2-host wiring the soak uses. (A larger
+        fleet would ring them: buddy of host i = store (i+1) % n.)"""
+        a.buddy, b.buddy = b, a
+
+    # -- writes ---------------------------------------------------------------
+
+    def put(self, snap: Snapshot) -> bool:
+        """File ``snap`` in the local ring and replicate it to the buddy.
+        Returns True when a buddy held a replica (the ``snapshot`` event's
+        ``replicated`` field)."""
+        with self._lock:
+            self._ring.append(snap)
+        if self.buddy is not None:
+            self.buddy.receive(self.host, snap.share())
+            return True
+        return False
+
+    def receive(self, origin: int, snap: Snapshot) -> None:
+        """Buddy side of :meth:`put`: hold ``origin``'s replica in a ring
+        of the same bound."""
+        with self._lock:
+            ring = self._replicas.get(origin)
+            if ring is None:
+                ring = self._replicas[origin] = deque(maxlen=self.ring)
+            ring.append(snap)
+
+    def drop_local(self) -> None:
+        """Forget the local ring — what a host loss does to RAM. The chaos
+        and test harnesses call this to force the peer/disk tiers."""
+        with self._lock:
+            self._ring.clear()
+
+    # -- reads ----------------------------------------------------------------
+
+    def local_snapshots(self) -> list:
+        """Own ring, newest first."""
+        with self._lock:
+            return list(self._ring)[::-1]
+
+    def peer_snapshots(self) -> list:
+        """This host's replicas as held by the buddy, newest first — the
+        peer RAM tier of the restore ladder."""
+        if self.buddy is None:
+            return []
+        with self.buddy._lock:
+            ring = self.buddy._replicas.get(self.host)
+            return list(ring)[::-1] if ring else []
+
+    def has_snapshots(self) -> bool:
+        return bool(self.local_snapshots() or self.peer_snapshots())
+
+    def newest_step(self) -> Optional[int]:
+        steps = [s.step for s in self.local_snapshots()]
+        steps += [s.step for s in self.peer_snapshots()]
+        return max(steps) if steps else None
+
+    # -- chaos hook -----------------------------------------------------------
+
+    def corrupt_newest(self, tier: str) -> bool:
+        """Flip one bit in the newest snapshot of ``tier`` (``local`` /
+        ``peer``) — the ``snap_corrupt`` chaos seam's actuator. The flip is
+        copy-on-write (the leaf is copied, flipped, and swapped into THIS
+        tier's Snapshot only), so the share()'d twin in the other tier
+        keeps the honest bytes. Returns False when the tier is empty or
+        holds no array leaf (the rule stays armed — firing on nothing would
+        record an injection that never happened)."""
+        import numpy as np
+
+        from thunder_tpu.core.pytree import tree_flatten, tree_unflatten
+
+        snaps = (self.local_snapshots() if tier == "local"
+                 else self.peer_snapshots())
+        # Corrupt the newest snapshot that is still VALID: the bit flip is
+        # an XOR, so "corrupting" an already-corrupted snapshot would undo
+        # the damage and silently re-validate the tier.
+        for snap in snaps:
+            if not snap.verify():
+                continue
+            flat, spec = tree_flatten(snap.state)
+            for i, leaf in enumerate(flat):
+                if isinstance(leaf, np.ndarray) and leaf.size:
+                    bad = leaf.copy()
+                    bad.view(np.uint8).reshape(-1)[0] ^= 1
+                    flat = list(flat)
+                    flat[i] = bad
+                    snap.state = tree_unflatten(spec, flat)
+                    return True
+            return False
+        return False

@@ -309,6 +309,19 @@ def wrap(fn: Callable, *, fn_name: Optional[str] = None,
 # =============================================================================
 
 
+def array_crc32(arr) -> int:
+    """crc32 over a host array's buffer (contiguity-normalized) — the one
+    integrity checksum shared by the SDC replica guard and the tiered
+    snapshot store (``resilience/snapshot.py``): both answer "are these the
+    bytes we wrote?" with the same cheap C-speed code."""
+    import numpy as np
+
+    arr = np.asarray(arr)
+    if not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
+    return zlib.crc32(arr)
+
+
 def replica_checksums(state) -> dict:
     """Per-leaf, per-replica-group crc32 checksums of a pytree of (possibly
     sharded) jax Arrays.
@@ -322,8 +335,6 @@ def replica_checksums(state) -> dict:
     import jax
 
     from thunder_tpu.core.pytree import tree_flatten
-
-    import numpy as np
 
     flat, _ = tree_flatten(state)
     out: dict = {}
@@ -350,11 +361,8 @@ def replica_checksums(state) -> dict:
                 continue
             per_dev = {}
             for sh in members:
-                arr = np.asarray(sh.data)
-                if not arr.flags.c_contiguous:
-                    arr = np.ascontiguousarray(arr)
                 # crc32 reads the array's buffer directly — no tobytes copy.
-                per_dev[sh.device.id] = zlib.crc32(arr)
+                per_dev[sh.device.id] = array_crc32(sh.data)
             replicated[idx] = per_dev
         if replicated:
             out[f"leaf{i}"] = replicated
