@@ -504,12 +504,44 @@ def _bench_obs_overhead():
     monitor.reset()
     (monitor.enable if was_enabled else monitor.disable)()
 
+    # Ops plane (ISSUE 15): its steady-state cost is one event tap (flight
+    # ring append + detector consume) per emitted record — one step_time
+    # per training step. Measured the same composed way: exact per-event
+    # cost × events-per-step over the step time, on vs off (off = the one
+    # module-global truth test the emit path always pays).
+    from thunder_tpu.observability import events as obs_events
+    from thunder_tpu.observability import opsplane
+
+    def event_ns(n=20_000):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            obs_events.emit_event("step_time", fn="ops_bench", step=0, s=0.01)
+        return (time.perf_counter() - t0) / n * 1e9
+
+    # Tap-level A/B: clearing/restoring the taps measures the per-event
+    # cost without tearing down a live plane's server (an autostarted
+    # THUNDER_TPU_OPS_PORT plane must keep serving through the bench).
+    saved_taps, saved_recorder = obs_events.ops_taps()
+    obs_events.set_ops_taps((), recorder=None)
+    ops_off_ns = event_ns()
+    if saved_taps:
+        obs_events.set_ops_taps(saved_taps, recorder=saved_recorder)
+        ops_on_ns = event_ns()
+    else:
+        opsplane.enable(serve=False)
+        ops_on_ns = event_ns()
+        opsplane.disable()
+    ops_off_pct = ops_off_ns / 1e3 / dispatch_us * 100.0
+    ops_pct = ops_on_ns / 1e3 / dispatch_us * 100.0
+
     disabled_pct = disabled_ns / 1e3 / dispatch_us * 100.0
     metrics_pct = enabled_ns / 1e3 / dispatch_us * 100.0
     print(f"# obs overhead: gpt-tiny warm dispatch {dispatch_us:.1f}us; obs code "
           f"{disabled_ns:.0f}ns/call disabled ({disabled_pct:.3f}%), "
-          f"{enabled_ns:.0f}ns/call metrics-on ({metrics_pct:.3f}%)", file=sys.stderr)
-    return dispatch_us, disabled_pct, metrics_pct
+          f"{enabled_ns:.0f}ns/call metrics-on ({metrics_pct:.3f}%); ops plane "
+          f"{ops_off_ns:.0f}ns/event off ({ops_off_pct:.4f}%), "
+          f"{ops_on_ns:.0f}ns/event on ({ops_pct:.4f}%)", file=sys.stderr)
+    return dispatch_us, disabled_pct, metrics_pct, ops_off_pct, ops_pct
 
 
 def _tpu_peak_tflops() -> float:
@@ -540,7 +572,8 @@ def main() -> None:
     os.environ.setdefault("THUNDER_TPU_ANNOTATE_TRACES", "1")
 
     _ensure_runtime()  # torch-faithful dtypes + persistent XLA compile cache
-    obs_dispatch_us, obs_disabled_pct, obs_metrics_pct = _bench_obs_overhead()
+    (obs_dispatch_us, obs_disabled_pct, obs_metrics_pct,
+     ops_off_pct, ops_pct) = _bench_obs_overhead()
     # Metrics stay ON for the rest of the run so the JSON line carries a
     # populated observability snapshot (ISSUE 4: BENCH_*.json embeds it).
     monitor.enable()
@@ -615,6 +648,12 @@ def main() -> None:
         "obs_gpt_block_dispatch_us": round(obs_dispatch_us, 1),
         "obs_disabled_overhead_pct": round(obs_disabled_pct, 4),
         "obs_metrics_overhead_pct": round(obs_metrics_pct, 4),
+        # Live ops plane (ISSUE 15): per-event tap cost (flight ring +
+        # detectors) composed over the warm dispatch at one event/step —
+        # the < 1% acceptance budget with the plane ON, and the cost of the
+        # bare module-global probe with it OFF.
+        "ops_overhead_pct": round(ops_pct, 4),
+        "ops_off_overhead_pct": round(ops_off_pct, 4),
         # Top-5 device-time attribution of the forward (None when the
         # backend has no profiler plugin): which trace lines eat the step.
         "attribution": attribution,

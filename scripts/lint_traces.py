@@ -56,6 +56,15 @@ Usage:
         # per-fault recovery cost within the soak noise floor of the
         # committed SOAK_r*.json round; full runs gate the committed
         # series via perf_report --gate
+    python scripts/lint_traces.py --ops
+        # live ops-plane smoke (ISSUE 15; docs/observability.md "ops
+        # plane"): start the per-host HTTP endpoint against a chaos'd GPT
+        # step — /healthz must flip degraded on a seeded straggler stream,
+        # /metrics must scrape mid-run with host labels AND the
+        # always-export drop counter at 0, an injected hang must leave a
+        # schema-valid flight-recorder dump, and the measured ops-plane
+        # overhead must stay under 1% of the step time (the same
+        # composition bench.py records as ops_overhead_pct)
     python scripts/lint_traces.py --chaos-multihost
         # mesh-wide resilience smoke (ISSUE 9): the FSDP×TP training step
         # on a virtual 8-device mesh under a canned host-loss +
@@ -840,6 +849,11 @@ _SOAK_REQUIRED_KEYS = (
     # Tiered checkpointing (ISSUE 14).
     "checkpoint_stall_ms_per_step", "snapshot_every", "soak_snapshots",
     "soak_restore_tiers", "soak_restore_fallthroughs",
+    # Live ops plane (ISSUE 15).
+    "soak_ops_port", "soak_anomalies", "soak_anomalies_total",
+    "soak_detection_lead", "soak_decisions_citing_anomaly",
+    "soak_undetected_detector_classes", "soak_flightrec_dumps",
+    "soak_flightrec_invalid", "soak_flightrec_missing",
 )
 
 # The hot loop's amortized checkpoint cost must stay snapshot-shaped (a
@@ -1005,6 +1019,40 @@ def _soak_smoke() -> int:
         print(f"    tiers OK: " + ", ".join(
             f"{t}×{n}" for t, n in sorted(tiers.items()))
             + f"; {result['soak_restore_fallthroughs']} fall-through(s)")
+    # Live ops plane (ISSUE 15): the detectors must have flagged every
+    # detector-covered fault class, an anomaly must PRECEDE the decision
+    # citing it (positive detection lead), and every timeout/halt must have
+    # left a schema-valid flight-recorder dump.
+    anomalies = result.get("soak_anomalies") or {}
+    if result.get("soak_undetected_detector_classes") or not anomalies:
+        n_errors += 1
+        print(f"    FAILED: detector coverage (anomalies={anomalies}, "
+              f"missed={result.get('soak_detector_classes_missed')})")
+    elif not (isinstance(result.get("soak_detection_lead"), (int, float))
+              and result["soak_detection_lead"] > 0):
+        n_errors += 1
+        print(f"    FAILED: detection lead "
+              f"{result.get('soak_detection_lead')} not > 0 (no decision "
+              f"cited a preceding anomaly)")
+    else:
+        print("    detectors OK: " + ", ".join(
+            f"{k}×{n}" for k, n in sorted(anomalies.items()))
+            + f"; lead {result['soak_detection_lead']:.2f}s over "
+            f"{result.get('soak_decisions_citing_anomaly')} cited decision(s)")
+    if (result.get("soak_flightrec_invalid")
+            or result.get("soak_flightrec_missing")
+            or not result.get("soak_flightrec_dumps")):
+        n_errors += 1
+        print(f"    FAILED: flight recorder "
+              f"(dumps={result.get('soak_flightrec_dumps')}, "
+              f"invalid={result.get('soak_flightrec_invalid')}, "
+              f"missing={result.get('soak_flightrec_missing')})")
+    else:
+        print(f"    flight recorder OK: "
+              + ", ".join(f"{r}×{n}" for r, n in sorted(
+                  (result.get('soak_flightrec_by_reason') or {}).items()))
+              + " dump(s), all schema-valid")
+
     n_errors += _torn_fallthrough_check()
 
     # Goodput sanity vs the committed round. The goodput RATIO swings with
@@ -1039,6 +1087,177 @@ def _soak_smoke() -> int:
 
     n_errors += _bench_history_gate("SOAK_r*.json")
     print(f"\nlint_traces --soak: {n_errors} error(s)")
+    return n_errors
+
+
+def _ops_smoke() -> int:
+    """--ops: live ops-plane smoke (ISSUE 15; docs/observability.md "ops
+    plane"). Starts the per-host HTTP server against a chaos'd GPT step and
+    asserts the four acceptance behaviors: /healthz flips degraded on a
+    seeded straggler (streaming detectors), /metrics scrapes mid-run with
+    host labels + the always-export drop counter, an injected hang leaves a
+    schema-valid flight-recorder dump, and the measured ops-plane overhead
+    stays under 1% of the step time (with exactly zero taps installed when
+    the plane is off). Returns the error count."""
+    import json
+    import tempfile
+    import time
+    import urllib.error
+    import urllib.request
+
+    import thunder_tpu as ttpu
+    import thunder_tpu.monitor as monitor
+    from thunder_tpu.analysis import Severity
+    from thunder_tpu.analysis.events import replay_events
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.observability import events as obs_events
+    from thunder_tpu.observability import opsplane
+    from thunder_tpu.observability.detect import DetectorConfig
+    from thunder_tpu.resilience import chaos, watchdog
+    from thunder_tpu.resilience.preemption import CheckpointManager, run_training
+
+    n_errors = 0
+    tmp = tempfile.mkdtemp(prefix="ttpu_ops_")
+    fr_dir = os.path.join(tmp, "flightrec")
+    plane = monitor.serve(port=0, flightrec_dir=fr_dir,
+                          detectors=DetectorConfig(min_samples=6, cooldown=20))
+    print(f"--- ops smoke: server on 127.0.0.1:{plane.port}")
+
+    def get(route):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{plane.port}{route}", timeout=10) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    cfg = m.name_to_config("gpt-tiny")
+    params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+    idx = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 32)).astype(np.int32)
+    jf = ttpu.jit(lambda p, i: m.forward(p, i, cfg), executors=["jax"])
+
+    def step_fn(state):
+        out = jf(params, idx)
+        return state, float(np.asarray(out).mean())
+
+    step_fn(None)  # compile outside the measured/chaos'd loop
+    t0 = time.perf_counter()
+    for _ in range(5):
+        step_fn(None)
+    step_s = (time.perf_counter() - t0) / 5
+
+    code, body = get("/healthz")
+    before = json.loads(body)["status"]
+
+    # A chaos'd training run: clean baseline steps, then a seeded straggler
+    # (sub-timeout slowdown inside the guarded step) the detectors must
+    # flag; /metrics is scraped MID-RUN from the step callback.
+    ccfg = chaos.ChaosConfig(rules=[], seed=0)
+    scraped = {}
+
+    def on_loss(step, loss):
+        if step == 11:
+            ccfg.rules.append(chaos.FaultRule(
+                "straggler", target="step", count=6,
+                delay_s=max(0.25, step_s * 4)))
+        if step == 18:
+            scraped["code"], scraped["body"] = get("/metrics")
+
+    with chaos.chaos_scope(ccfg):
+        run_training(step_fn, None, 24,
+                     manager=CheckpointManager(os.path.join(tmp, "ck")),
+                     watchdog_timeout_s=60.0, on_loss=on_loss)
+
+    code, body = get("/healthz")
+    after = json.loads(body)
+    anomalies = [a.kind for a in plane.bank.recent_anomalies()]
+    if before != "ok" or after["status"] == "ok" or not anomalies:
+        n_errors += 1
+        print(f"    FAILED: healthz did not flip on the straggler "
+              f"(before={before}, after={after['status']}, "
+              f"anomalies={anomalies})")
+    else:
+        print(f"    healthz OK: ok -> {after['status']} on anomalies "
+              f"{sorted(set(anomalies))}")
+
+    mtext = scraped.get("body") or ""
+    if (scraped.get("code") != 200
+            or "thunder_tpu_event_log_dropped_total" not in mtext
+            or 'host="' not in mtext):
+        n_errors += 1
+        print(f"    FAILED: mid-run /metrics scrape (code="
+              f"{scraped.get('code')}, drop-counter present: "
+              f"{'thunder_tpu_event_log_dropped_total' in mtext}, "
+              f"host label present: {'host=' in mtext})")
+    else:
+        print(f"    /metrics OK mid-run: {len(mtext.splitlines())} lines, "
+              f"host-labelled, always-export drop counter present")
+
+    # An injected hang must turn into a typed timeout AND a schema-valid
+    # flight-recorder dump carrying its preceding context.
+    with chaos.chaos_scope("collective_hang~30"):
+        try:
+            watchdog.guard_call(lambda: None, (), fn_name="gpt_step",
+                                timeout_s=0.2)
+            n_errors += 1
+            print("    FAILED: injected hang did not raise")
+        except watchdog.CollectiveTimeoutError:
+            pass
+    import glob as _glob
+
+    dumps = _glob.glob(os.path.join(fr_dir, "*collective_timeout.jsonl"))
+    if not dumps:
+        n_errors += 1
+        print("    FAILED: no flight-recorder dump for the hang")
+    else:
+        summary, diags = replay_events(dumps[-1])
+        errs = [d for d in diags if d.severity >= Severity.ERROR]
+        kinds = summary.get("kinds", {})
+        if errs or not kinds.get("collective_timeout") \
+                or not summary.get("flightrec_dumps"):
+            n_errors += 1
+            print(f"    FAILED: dump replay ({len(errs)} error(s), "
+                  f"kinds={kinds})")
+        else:
+            print(f"    flight recorder OK: {os.path.basename(dumps[-1])} "
+                  f"({summary['lines']} records, schema-valid, "
+                  f"0 correlation errors)")
+    code, body = get("/debug/flightrec")
+    if code != 200 or not json.loads(body).get("path"):
+        n_errors += 1
+        print(f"    FAILED: /debug/flightrec ({code}: {body[:120]})")
+    code, body = get("/debug/state")
+    state = json.loads(body) if code == 200 else {}
+    if code != 200 or "cache" not in state or "autopilot" not in state:
+        n_errors += 1
+        print(f"    FAILED: /debug/state ({code})")
+
+    # Overhead: the ops plane's per-step cost is one tap per emitted event
+    # (steady state: one step_time event per step). Composed against the
+    # measured step time like bench.py's obs-overhead protocol — an A/B
+    # wall-clock diff at <1% would drown in host noise.
+    N = 20_000
+    t0 = time.perf_counter()
+    for _ in range(N):
+        obs_events.emit_event("step_time", fn="overhead_probe", step=0, s=0.01)
+    tap_ns = (time.perf_counter() - t0) / N * 1e9
+    ops_pct = tap_ns / (step_s * 1e9) * 100.0
+    monitor.shutdown_ops()
+    if obs_events.ops_active():
+        n_errors += 1
+        print("    FAILED: taps still installed after shutdown_ops()")
+    if ops_pct >= 1.0:
+        n_errors += 1
+        print(f"    FAILED: ops-plane overhead {ops_pct:.3f}% of the "
+              f"{step_s * 1e3:.1f}ms step (budget < 1%)")
+    else:
+        print(f"    overhead OK: {tap_ns:.0f}ns/event = {ops_pct:.4f}% of "
+              f"the {step_s * 1e3:.1f}ms step (< 1%); plane off installs "
+              f"zero taps")
+
+    print(f"\nlint_traces --ops: {n_errors} error(s)")
     return n_errors
 
 
@@ -1267,6 +1486,9 @@ def main(argv=None) -> int:
 
     if "--soak" in argv:
         return 1 if _soak_smoke() else 0
+
+    if "--ops" in argv:
+        return 1 if _ops_smoke() else 0
 
     if "--chaos" in argv:
         return 1 if _chaos_smoke() else 0

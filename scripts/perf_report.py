@@ -357,7 +357,38 @@ def run_history_gate(
             f"{os.path.basename(ack_path or 'BENCH_ACK.json')}",
             file=out,
         )
-    return 1 if (gate and fresh) else 0
+    ops_failures = _ops_plane_failures(rounds[-1])
+    if ops_failures:
+        print(
+            "\nperf_report: ops-plane acceptance failed on the newest soak "
+            "round: " + ", ".join(ops_failures), file=out,
+        )
+    return 1 if (gate and (fresh or ops_failures)) else 0
+
+
+def _ops_plane_failures(newest: tuple) -> list[str]:
+    """Absolute ops-plane checks on the newest SOAK round (ISSUE 15) —
+    unlike the direction-aware deltas, these are pass/fail invariants:
+    every soak fault class with a streaming detector must have raised at
+    least one anomaly, detection lead must be positive, and every
+    timeout/halt must have produced a schema-valid flight-recorder dump.
+    Rounds predating the ops plane (no soak_ops keys) are exempt."""
+    label, m = newest
+    if not str(m.get("_metric_name", "")).startswith("soak"):
+        return []
+    if "soak_undetected_detector_classes" not in m:
+        return []  # pre-ops-plane round
+    out = []
+    for key in ("soak_undetected_detector_classes", "soak_flightrec_invalid",
+                "soak_flightrec_missing"):
+        v = m.get(key)
+        if v:
+            out.append(f"{label}: {key}={v:g}")
+    lead = m.get("soak_detection_lead")
+    if lead is not None and lead <= 0:
+        out.append(f"{label}: soak_detection_lead={lead:g} (need > 0: an "
+                   f"anomaly must precede the decision citing it)")
+    return out
 
 
 # =============================================================================

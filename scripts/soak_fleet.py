@@ -60,9 +60,20 @@ def _log(msg: str) -> None:
 # checkpoint_halt, ckpt_io -> the manager's own retry; the tiered-
 # checkpoint seams (ISSUE 14) -> the snapshot pipeline degrades one tier
 # and keeps going (torn/slow flush -> a later commit; corrupt replica ->
-# the restore ladder's checksum fall-through).
+# the restore ladder's checksum fall-through); straggler (ISSUE 15) -> a
+# sub-timeout slowdown the STREAMING DETECTORS must flag (anomaly event,
+# positive detection lead) before any watchdog timeout would.
 REQUIRED_SEAMS = ("host_loss", "collective_hang", "sdc", "oom", "ckpt_io",
-                  "preempt", "snap_torn", "snap_corrupt", "snap_slow")
+                  "preempt", "snap_torn", "snap_corrupt", "snap_slow",
+                  "straggler")
+
+# Fault classes a streaming detector covers (ISSUE 15): the soak gate
+# requires >=1 anomaly of the mapped kinds whenever the class was injected
+# (perf_report checks soak_undetected_detector_classes == 0).
+DETECTED_FAULT_CLASSES = {
+    "straggler": ("step_time_drift", "goodput_drop", "host_spread"),
+    "oom": ("recompile_storm",),
+}
 # The filler pool excludes preempt: each preempt is a full
 # checkpoint-and-halt + process-restart cycle, and one per soak is the
 # scenario; a schedule of mostly restarts would measure restart latency,
@@ -116,6 +127,11 @@ def make_schedule(seed: int, n_steps: int, n_faults: int,
         if pick == "oom" and seams.count("oom") >= 3:
             continue
         seams.append(pick)
+    # The recompile-storm detector needs >=2 recompiles inside its window
+    # (ISSUE 15): with any filler slots at all, guarantee a second oom so
+    # the storm anomaly is deterministic on every seed.
+    if len(seams) > len(REQUIRED_SEAMS) and seams.count("oom") < 2:
+        seams[len(REQUIRED_SEAMS)] = "oom"
     rng.shuffle(seams)
     # The preempt goes late: everything after it replays in the "restarted
     # process", and a very early halt would leave most faults untested
@@ -151,12 +167,62 @@ def make_schedule(seed: int, n_steps: int, n_faults: int,
             while step in preempt_steps:
                 step = lo + rng.randrange(max(1, early_hi - lo))
             f.step = step
+    # Straggler placement (ISSUE 15): late enough that the step-time
+    # detectors have a baseline (min_samples of clean steps), and with at
+    # least one elastic-driving fault still AHEAD of it — the anomaly must
+    # precede a hang/host-loss decision for detection lead to be positive
+    # and measurable.
+    straggler_step = None
+    for f in schedule:
+        if f.seam == "straggler":
+            f.step = min(10 + rng.randrange(4), hi)
+            while f.step in preempt_steps:
+                f.step += 1
+            straggler_step = f.step
     elastic_hosts = [f for f in schedule
                      if f.seam in ("host_loss", "collective_hang")]
+    if straggler_step is not None and elastic_hosts and not any(
+            f.step > straggler_step + 2 for f in elastic_hosts):
+        # Every hang/host-loss landed before the straggler window: push the
+        # latest one past it so its decision can cite the anomaly.
+        latest = max(elastic_hosts, key=lambda f: f.step)
+        latest.step = min(straggler_step + 4 + rng.randrange(3), hi)
+        while latest.step in preempt_steps:
+            latest.step += 1
+    # snap_corrupt co-schedules AFTER the adjustments above so the restore
+    # that must follow it really does (the host it rides may have moved).
     for f in schedule:
         if f.seam == "snap_corrupt" and elastic_hosts:
             f.step = rng.choice(elastic_hosts).step
             f.target = "local"
+    # Re-pinning (lazy snap seams, the straggler, the elastic adjustment)
+    # can strand an overlap-tail entry alone on its step: repair by
+    # co-scheduling movable mid-weight seams (armed-at-step, position-
+    # insensitive) until the requested pairs are back.
+    def _pairs() -> int:
+        by_step: dict[int, int] = {}
+        for f in schedule:
+            by_step[f.step] = by_step.get(f.step, 0) + 1
+        return sum(n - 1 for n in by_step.values() if n > 1)
+
+    while _pairs() < overlap_pairs:
+        counts: dict[int, int] = {}
+        for f in schedule:
+            counts[f.step] = counts.get(f.step, 0) + 1
+        movable = [f for f in schedule
+                   if f.seam in ("sdc", "ckpt_io", "oom")
+                   and counts[f.step] == 1]
+        targets = [f for f in schedule
+                   if f.seam not in ("preempt", "straggler")
+                   and f.step not in preempt_steps]
+        if not movable:
+            break
+        mover = movable[-1]
+        choices = [f for f in targets
+                   if f is not mover and f.step != mover.step]
+        if not choices:
+            break
+        mover.step = rng.choice(choices).step
     schedule.sort(key=lambda f: (f.step, f.seam))
     return schedule
 
@@ -189,6 +255,13 @@ def arm_fault(cfg, fault: ScheduledFault, *, hang_delay_s: float) -> None:
     elif seam == "snap_corrupt":
         # Fires at the next tiered restore; the target picks the tier(s).
         cfg.rules.append(FaultRule(seam, target=fault.target or "local"))
+    elif seam == "straggler":
+        # Sub-timeout slowdown over several consecutive guarded steps
+        # (target "step" fires inside watchdog.guard_call, never on the
+        # sidecar): big vs the ms-scale CPU-mesh step, far below the
+        # watchdog timeout — only the streaming detectors can see it.
+        cfg.rules.append(FaultRule(seam, target="step", count=5,
+                                   delay_s=hang_delay_s / 200.0))
     else:  # sdc, oom, ckpt_io, snap_torn: fire at their next seam visit
         cfg.rules.append(FaultRule(seam))
 
@@ -311,6 +384,40 @@ def run_soak(args) -> dict:
     log = os.path.join(tmp, "events.jsonl")
     monitor.set_event_log(log)
 
+    # The schedule is built FIRST (deterministic per seed) so the detector
+    # config below can be sized to what it will actually inject.
+    schedule = make_schedule(args.seed, args.steps, args.faults,
+                             overlap_pairs=args.overlap_pairs)
+    n_ooms = sum(1 for f in schedule if f.seam == "oom")
+
+    # Live ops plane (ISSUE 15): the soak runs scrapeable — per-host
+    # /metrics + /healthz on an ephemeral port, the flight recorder dumping
+    # on every timeout/SDC/halt, and the streaming detectors (tuned to the
+    # soak's compressed timescale) feeding anomalies into the autopilot.
+    plane = None
+    flightrec_dir = os.path.join(tmp, "flightrec")
+    if args.ops_plane:
+        from thunder_tpu.observability import opsplane
+        from thunder_tpu.observability.detect import DetectorConfig
+
+        plane = opsplane.enable(
+            port=0, serve=True,
+            flightrec_dir=flightrec_dir, flightrec_keep=64,
+            detectors=DetectorConfig(
+                min_samples=6, cooldown=20, goodput_consecutive=3,
+                # N recompiles inside the run = a storm at soak scale,
+                # sized to the schedule's oom count (>=2 whenever it has a
+                # filler slot; a minimum-size schedule carries one oom and
+                # the gate must stay deterministic, not hope for
+                # incidental recompiles).
+                recompile_threshold=min(2, max(1, n_ooms)),
+                recompile_window_s=3600.0,
+            ),
+        )
+        _log(f"ops plane: http://127.0.0.1:{plane.port} "
+             f"(/metrics /healthz /debug/state); flight recorder -> "
+             f"{flightrec_dir}")
+
     (mesh, state0, build_for_mesh, specs_for_mesh, sidecar,
      tokens_per_step) = _build_workload(args)
     from thunder_tpu.resilience.elastic import mesh_shape
@@ -328,8 +435,6 @@ def run_soak(args) -> dict:
     _log(f"ideal step {ideal_step_s * 1e3:.1f}ms -> {ideal_tps:.0f} tok/s; "
          f"resilience overhead {overhead_pct:.2f}%")
 
-    schedule = make_schedule(args.seed, args.steps, args.faults,
-                             overlap_pairs=args.overlap_pairs)
     n_overlap = overlapping_pairs(schedule)
     by_seam: dict[str, int] = {}
     for f in schedule:
@@ -425,11 +530,87 @@ def run_soak(args) -> dict:
                 losses[i] = v
     steps_executed = sum(r.steps_executed for r in reports)
 
+    ops_healthz = None
+    ops_port = plane.port if plane is not None else None
+    if plane is not None:
+        # One end-of-run scrape proves the endpoints served a real run.
+        try:
+            import urllib.error
+            import urllib.request
+
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{plane.port}/healthz", timeout=5) as r:
+                    body = r.read().decode()
+            except urllib.error.HTTPError as e:
+                body = e.read().decode()  # 503 = a served "critical" verdict
+            ops_healthz = json.loads(body).get("status")
+        except Exception as e:
+            ops_healthz = f"unreachable: {e}"
+
     monitor.set_event_log(None)
     summary, diags = replay_events(log, storm_threshold=64)
     errors = [d for d in diags if d.severity >= Severity.ERROR]
     for line in format_replay(summary, diags).splitlines():
         _log(line)
+
+    # Ops-plane accounting (ISSUE 15), all from durable artifacts: anomaly
+    # counts from the replayed log; detection lead from decisions whose
+    # evidence cites a detector anomaly (decision ts − anomaly ts > 0 means
+    # the detectors saw the fault coming); flight-recorder dumps validated
+    # file by file against the same schema + correlation rules.
+    anomalies = dict(summary.get("anomalies") or {})
+    leads: list = []
+    cited = 0
+    with open(log) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("kind") != "autopilot_decision":
+                continue
+            ev = rec.get("evidence")
+            an = ev.get("anomaly") if isinstance(ev, dict) else None
+            if not an:
+                continue
+            cited += 1
+            try:
+                leads.append(float(rec["ts"]) - float(an["ts"]))
+            except (KeyError, TypeError, ValueError):
+                pass
+    positive_leads = [l for l in leads if l > 0]
+    detection_lead = round(max(positive_leads), 3) if positive_leads else 0.0
+    undetected = sorted(
+        seam for seam, kinds in DETECTED_FAULT_CLASSES.items()
+        if by_seam.get(seam) and not any(anomalies.get(k) for k in kinds)
+    )
+    import glob as _glob
+
+    dump_paths = sorted(_glob.glob(
+        os.path.join(flightrec_dir, "flightrec-*.jsonl")))
+    n_invalid = 0
+    dump_reasons: dict = {}
+    for p in dump_paths:
+        dsum, ddiags = replay_events(p)
+        if any(d.severity >= Severity.ERROR for d in ddiags):
+            n_invalid += 1
+        with open(p) as f:
+            last = f.readlines()[-1]
+        try:
+            reason = str(json.loads(last).get("reason"))
+        except ValueError:
+            reason = "?"
+        dump_reasons[reason] = dump_reasons.get(reason, 0) + 1
+    timeouts = int(summary.get("kinds", {}).get("collective_timeout") or 0)
+    dumps_missing = (
+        max(0, timeouts - dump_reasons.get("collective_timeout", 0))
+        + max(0, halts - dump_reasons.get("autopilot_halt", 0))
+    ) if plane is not None else 0
+    if plane is not None:
+        from thunder_tpu.observability import opsplane
+
+        opsplane.disable()
 
     useful_tokens = args.steps * tokens_per_step
     tps = useful_tokens / wall_s if wall_s else 0.0
@@ -494,6 +675,24 @@ def run_soak(args) -> dict:
         "soak_snapshots": summary.get("snapshots") or 0,
         "soak_restore_tiers": summary.get("restore_tiers") or {},
         "soak_restore_fallthroughs": summary.get("restore_fallthroughs") or 0,
+        # Live ops plane (ISSUE 15): streaming-detector anomalies, the
+        # detection lead (max positive decision-ts − cited-anomaly-ts: >0
+        # means a detector flagged the fault before the autopilot had to
+        # act on it), detector coverage per fault class, and the flight
+        # recorder's per-fault black-box dumps (validated against the event
+        # schema + correlation rules, one by one).
+        "soak_ops_port": ops_port,
+        "soak_ops_healthz": ops_healthz,
+        "soak_anomalies": anomalies,
+        "soak_anomalies_total": sum(anomalies.values()),
+        "soak_detection_lead": detection_lead,
+        "soak_decisions_citing_anomaly": cited,
+        "soak_undetected_detector_classes": len(undetected),
+        "soak_detector_classes_missed": undetected,
+        "soak_flightrec_dumps": len(dump_paths),
+        "soak_flightrec_by_reason": dump_reasons,
+        "soak_flightrec_invalid": n_invalid,
+        "soak_flightrec_missing": dumps_missing,
         "events_log": log,
     }
     _log(f"goodput {goodput:.0f} tok/s ({ratio * 100:.1f}% of ideal "
@@ -508,6 +707,16 @@ def run_soak(args) -> dict:
          + (", ".join(f"{t}×{n}" for t, n in
                       sorted(result['soak_restore_tiers'].items())) or "none")
          + f", {result['soak_restore_fallthroughs']} fall-through(s)")
+    if plane is not None:
+        _log(f"ops: anomalies "
+             + (", ".join(f"{k}×{n}" for k, n in sorted(anomalies.items()))
+                or "none")
+             + f"; detection lead {detection_lead:.2f}s over {cited} cited "
+             f"decision(s); dumps "
+             + (", ".join(f"{r}×{n}" for r, n in sorted(dump_reasons.items()))
+                or "none")
+             + f" ({n_invalid} invalid, {dumps_missing} missing); "
+             f"healthz={ops_healthz}")
     return result
 
 
@@ -518,14 +727,25 @@ def run_soak(args) -> dict:
 
 def soak_ok(result: dict) -> bool:
     """The soak's pass condition (the acceptance gate): nothing unrecovered,
-    nothing unactuated, no replay errors, a finite final loss."""
+    nothing unactuated, no replay errors, a finite final loss — and, with
+    the ops plane on (ISSUE 15), every detector-covered fault class raised
+    an anomaly, detection lead is positive, and every timeout/halt produced
+    a schema-valid flight-recorder dump."""
     loss = result.get("soak_final_loss")
-    return (
+    ok = (
         result.get("soak_unrecovered") == 0
         and result.get("soak_unactuated") == 0
         and result.get("soak_replay_errors") == 0
         and loss is not None and loss == loss  # not NaN
     )
+    if ok and result.get("soak_ops_port") is not None:
+        ok = (
+            result.get("soak_undetected_detector_classes") == 0
+            and result.get("soak_detection_lead", 0) > 0
+            and result.get("soak_flightrec_invalid") == 0
+            and result.get("soak_flightrec_missing") == 0
+        )
+    return ok
 
 
 def main(argv=None) -> int:
@@ -557,14 +777,22 @@ def main(argv=None) -> int:
                    help="healthy steps on a shrunk mesh before resharding "
                         "back up to the full mesh (0 disables)")
     p.add_argument("--max-restarts", type=int, default=8)
+    p.add_argument("--ops-plane", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="live ops plane (ISSUE 15): /metrics + /healthz on "
+                        "an ephemeral port, flight-recorder dumps per "
+                        "fault, streaming detectors feeding the autopilot")
     p.add_argument("--smoke", action="store_true",
-                   help="CI-sized run: 40 steps, 10 faults (lint_traces --soak)")
+                   help="CI-sized run: 40 steps, 11 faults (lint_traces --soak)")
     p.add_argument("--workdir", default=None)
     p.add_argument("--out", default=None, help="also write the JSON here")
     p.add_argument("--_subprocess", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
     if args.smoke:
-        args.steps, args.faults, args.save_every = 40, 10, 5
+        # 11 faults = every required seam + one filler slot, which the
+        # schedule turns into the second oom the recompile-storm detector
+        # needs (ISSUE 15).
+        args.steps, args.faults, args.save_every = 40, 11, 5
         args.snapshot_every = 2
         args.regrow_after = 10
     if not args.regrow_after:

@@ -73,6 +73,14 @@ SCHEMA: dict[str, frozenset] = {
     # soak driver summarizes its run with one goodput record.
     "autopilot_decision": frozenset({"decision_id", "signal", "actuator"}),
     "goodput": frozenset({"goodput_tokens_per_sec", "useful_tokens", "wall_s"}),
+    # Live ops plane (ISSUE 15; docs/observability.md "ops plane"): one
+    # record per streaming-detector verdict (kind, severity, value vs
+    # baseline, evidence window), and the trailer marker a flight-recorder
+    # dump file ends with. The marker appears ONLY in flightrec-*.jsonl
+    # dumps — its presence tells the correlation rules below that the log
+    # is a fault-in-progress capture.
+    "anomaly": frozenset({"anomaly", "severity", "value", "baseline"}),
+    "flightrec_dump": frozenset({"reason", "records"}),
 }
 _COMMON = frozenset({"v", "ts", "seq", "kind"})
 
@@ -215,7 +223,14 @@ def host_health(
     else:
         records = list(source)
 
-    per_host: dict[Any, list[float]] = {}
+    # The incremental accumulator (observability/detect.py, ISSUE 15
+    # satellite): one class owns the per-host stats + spread math for BOTH
+    # this offline merged-log summary and the online streaming spread
+    # detector. Running sums in record order reproduce the old from-scratch
+    # recompute bit for bit (sum() was left-to-right too).
+    from thunder_tpu.observability.detect import HostHealthAccumulator
+
+    acc = HostHealthAccumulator()
     for rec in records:
         if not isinstance(rec, dict) or rec.get("kind") != "step_time":
             continue
@@ -223,25 +238,16 @@ def host_health(
             s = float(rec["s"])
         except (KeyError, TypeError, ValueError):
             continue
-        per_host.setdefault(rec.get("host") or 0, []).append(s)
+        acc.add(rec.get("host") or 0, s)
 
-    hosts = {
-        h: {
-            "steps": len(ts),
-            "mean_s": sum(ts) / len(ts),
-            "max_s": max(ts),
-        }
-        for h, ts in per_host.items()
-    }
+    hosts = acc.host_stats()
     summary: dict[str, Any] = {"hosts": hosts, "spread_ratio": None, "stragglers": []}
     if hosts:
-        means = sorted(st["mean_s"] for st in hosts.values())
         # True median (even fleets average the middle pair): taking the
         # upper-middle element would make the slow host of a 2-host fleet
-        # its own baseline and hide the skew entirely.
-        mid = len(means) // 2
-        median = means[mid] if len(means) % 2 else 0.5 * (means[mid - 1] + means[mid])
-        spread = max(means) / median if median else 0.0
+        # its own baseline and hide the skew entirely (the accumulator
+        # implements exactly that).
+        median, spread = acc.spread()
         summary["spread_ratio"] = round(spread, 4)
         from thunder_tpu.observability import metrics as obsm
         from thunder_tpu.observability.events import emit_event
@@ -313,6 +319,12 @@ def replay_events(
     fault_events: list[tuple[int, str, dict]] = []  # (lineno, seam, record)
     decision_events: list[tuple[int, str, dict]] = []  # (lineno, actuator, record)
     recovery_positions: dict[str, list[int]] = {}  # recovery kind -> linenos
+    anomaly_counts: dict[str, int] = {}  # detector kind -> events (ISSUE 15)
+    # flightrec_dump trailer positions: present ONLY in flight-recorder
+    # dump files. A dump marker after a fault/decision satisfies the
+    # correlation rules below — the dump is a capture of a fault whose
+    # recovery is still in flight, not evidence the run lost it.
+    dump_positions: list[int] = []
     restore_tiers: dict[str, int] = {}  # tier -> ok restores
     restore_fallthroughs = 0  # ok restores that skipped >=1 invalid candidate
     snapshot_stall_ms = 0.0
@@ -440,6 +452,11 @@ def replay_events(
                     snapshot_stall_ms += float(rec.get("stall_ms") or 0.0)
                 except (TypeError, ValueError):
                     pass
+            elif kind == "anomaly":
+                a = str(rec.get("anomaly"))
+                anomaly_counts[a] = anomaly_counts.get(a, 0) + 1
+            elif kind == "flightrec_dump":
+                dump_positions.append(lineno)
 
     for fn, n in sorted(exact_compiles_by_fn.items()):
         if n > storm_threshold:
@@ -493,6 +510,12 @@ def replay_events(
         expected = FAULT_RECOVERY_KINDS.get(seam)
         if not expected:
             continue
+        if any(pos > lineno for pos in dump_positions):
+            # A flight-recorder dump landed after this injection: the log
+            # is a black-box capture taken AT fault time (only dump files
+            # carry the marker) — the recovery runs in the process that
+            # continues, outside this snapshot.
+            continue
         if not any(
             pos > lineno for k in expected for pos in recovery_positions.get(k, [])
         ):
@@ -519,6 +542,8 @@ def replay_events(
         expected = DECISION_RECOVERY_KINDS.get(actuator)
         if not expected:
             continue
+        if any(pos > lineno for pos in dump_positions):
+            continue  # fault-in-progress capture (see the fault rule above)
         if not any(
             pos > lineno for k in expected for pos in recovery_positions.get(k, [])
         ):
@@ -562,6 +587,11 @@ def replay_events(
         "restore_fallthroughs": restore_fallthroughs,
         "snapshots": n_snapshots,
         "snapshot_stall_ms_total": round(snapshot_stall_ms, 3),
+        # Live ops plane (ISSUE 15): streaming-detector verdicts by kind,
+        # and flight-recorder dump markers (non-zero only when replaying a
+        # flightrec-*.jsonl capture).
+        "anomalies": anomaly_counts,
+        "flightrec_dumps": len(dump_positions),
     }
     return summary, diags
 
@@ -610,6 +640,12 @@ def format_replay(summary: dict, diags: list[Diagnostic]) -> str:
         lines.append(
             f"  snapshots: {summary['snapshots']} "
             f"(stall total {summary.get('snapshot_stall_ms_total', 0.0)} ms)"
+        )
+    if summary.get("anomalies"):
+        lines.append(
+            "  anomalies: " + ", ".join(
+                f"{k}×{n}" for k, n in sorted(summary["anomalies"].items())
+            )
         )
     for d in diags:
         lines.append("  " + d.format().replace("\n", "\n  "))

@@ -556,6 +556,11 @@ def _record_compile_phase(compile_id, phase: str, seconds: float, *,
     if target is not None:
         target.emit("compile_phase", compile_id=compile_id, phase=phase,
                     s=round(seconds, 6), **extra)
+    else:
+        # No JSONL sink: the ops-plane taps (flight ring) still get the
+        # span — compile phases are exactly the context a fault dump needs.
+        obs_events.tap_event("compile_phase", dict(
+            compile_id=compile_id, phase=phase, s=round(seconds, 6), **extra))
 
 
 def _compile_entry_impl(
@@ -1283,6 +1288,37 @@ def _sum_phases(entries) -> dict:
     return {k: round(v, 6) for k, v in sorted(out.items())}
 
 
+# Live jitted functions, weakly held — the ops plane's /debug/state reads
+# each one's cache/compile summary without the operator having to hold a
+# handle (observability/opsplane.py). WeakSet: registration must never be
+# the thing keeping a dropped function's cache entries alive.
+import weakref as _weakref
+
+_live_functions: "_weakref.WeakSet" = _weakref.WeakSet()
+
+
+def live_function_state() -> list[dict]:
+    """Per-function cache/compile summaries across every live jitted
+    function — :func:`cache_info` trimmed to what an operator scans (entry
+    lists collapsed to counts + per-entry de-opt levels)."""
+    out = []
+    for f in list(_live_functions):
+        try:
+            info = cache_info(f)
+        except Exception:
+            continue
+        entries = info.pop("entries", [])
+        info["n_entries"] = len(entries)
+        info["entry_degradation_levels"] = [
+            e.get("degradation_level", 0) for e in entries
+        ]
+        info["fn"] = getattr(f, "__name__", "?")
+        info["trace_seconds"] = round(info.get("trace_seconds") or 0.0, 4)
+        info["first_run_seconds"] = round(info.get("first_run_seconds") or 0.0, 4)
+        out.append(info)
+    return sorted(out, key=lambda i: str(i.get("fn")))
+
+
 def cache_info(fn: Callable) -> dict:
     """Cache observability for a thunder_tpu-compiled function: aggregate and
     per-entry hit/miss/recompile counters plus cumulative trace/first-run
@@ -1343,6 +1379,17 @@ def _ensure_runtime() -> None:
     # Tap jax's compilation-cache monitoring events so first-run compile
     # spans can say "hit" (deserialize) vs "miss" (real backend compile).
     _install_jax_cache_listener()
+
+    # Ops plane autostart (ISSUE 15): THUNDER_TPU_OPS_PORT arms the live
+    # endpoints + flight recorder with zero code changes — the scheduler
+    # exports one port per process and the fleet is scrapeable. One env
+    # probe here; nothing is imported (let alone served) without it.
+    import os as _os
+
+    if _os.environ.get("THUNDER_TPU_OPS_PORT", "").strip():
+        from thunder_tpu.observability import opsplane as _opsplane
+
+        _opsplane.maybe_autostart()
 
     # Persistent XLA compilation cache (reference analogue: nvFuser's
     # descriptor-keyed compiled-fusion cache, SURVEY.md §2.2 — here the
@@ -1643,6 +1690,10 @@ def jit(
                 # through to the recompile path below. Anything unrecognized
                 # propagates untouched.
                 if not deopt_mod.handle_run_failure(e, cd, cs, entry, 0):
+                    # Unhandled dispatch fault: the flight ring's preceding
+                    # context dumps before the raise unwinds (ISSUE 15;
+                    # no-op one-probe when the ops plane is off).
+                    obs_events.flight_dump("dispatch_fault")
                     raise
                 entry = None
                 # Re-account the call as a miss (it recompiles below), and
@@ -1670,11 +1721,12 @@ def jit(
         cs.cache_misses += 1
         if obsm.enabled():
             obsm.CACHE_MISSES.inc()
-        _obs_log = getattr(cd, "_event_log", None) or obs_events.active_log()
-        if _obs_log is not None:
-            _obs_log.emit(
-                "cache_miss", fn=getattr(cd.fn, "__name__", repr(cd.fn)), call=cs.calls
-            )
+        # emit_event: fn_ already routed the per-function log (event_scope),
+        # so the active log is the right sink — and the ops-plane taps see
+        # the miss even with no log configured (ISSUE 15).
+        obs_events.emit_event(
+            "cache_miss", fn=getattr(cd.fn, "__name__", repr(cd.fn)), call=cs.calls
+        )
         # Compile + first run under the recovery driver: a failure that
         # classifies as a kernel fault demotes the claimed executor and
         # re-claims; a compile failure/OOM climbs the de-opt ladder; both
@@ -1688,6 +1740,7 @@ def jit(
                 if deopt_mod.handle_compile_failure(e, cd, cs, attempt):
                     attempt += 1
                     continue
+                obs_events.flight_dump("dispatch_fault")
                 raise
             if key is not None:
                 if len(cs.fast_cache) > _FAST_CACHE_MAX:
@@ -1707,6 +1760,7 @@ def jit(
                         cs.fast_cache.clear()
                     attempt += 1
                     continue
+                obs_events.flight_dump("dispatch_fault")
                 raise
             break
         entry.stats.first_run_s = (timer_ns() - run_start) / 1e9
@@ -1752,6 +1806,7 @@ def jit(
 
     fn_._lc_cd = cd
     fn_._lc_cs = cs
+    _live_functions.add(fn_)  # ops-plane /debug/state enumeration
     return fn_
 
 

@@ -73,6 +73,50 @@ def host_health(source, *, spread_threshold: float = 1.5):
     return _hh(source, spread_threshold=spread_threshold)
 
 
+def serve(port: Optional[int] = None, **options):
+    """Start the live ops plane (ISSUE 15; docs/observability.md "ops
+    plane"): a per-host stdlib-threaded HTTP endpoint serving ``/metrics``
+    (:func:`prometheus_text` with host labels), ``/healthz`` (the typed
+    verdict), ``/debug/state``, and ``/debug/flightrec`` — plus the flight
+    recorder and the streaming anomaly detectors riding the event taps.
+    ``port`` 0 binds an ephemeral port (read it from the returned plane's
+    ``.port``); default is ``THUNDER_TPU_OPS_PORT``. Off by default; with
+    it off the hot paths pay nothing. ``options`` forward to
+    ``observability.opsplane.enable`` (flightrec_dir, detectors, ...)."""
+    from thunder_tpu.observability import opsplane
+
+    options.setdefault("serve", True)
+    return opsplane.enable(port=port, **options)
+
+
+def ops_health() -> dict:
+    """The ``/healthz`` verdict, in-process (no server needed)."""
+    from thunder_tpu.observability import opsplane
+
+    return opsplane.health_verdict()
+
+
+def ops_state() -> dict:
+    """The ``/debug/state`` payload, in-process."""
+    from thunder_tpu.observability import opsplane
+
+    return opsplane.debug_state()
+
+
+def flight_dump(reason: str = "manual"):
+    """Dump the flight recorder's ring now (None when the plane is off)."""
+    from thunder_tpu.observability.events import flight_dump as _fd
+
+    return _fd(reason)
+
+
+def shutdown_ops() -> None:
+    """Stop the ops server and uninstall the event taps."""
+    from thunder_tpu.observability import opsplane
+
+    opsplane.disable()
+
+
 def configure_watchdog(timeout_s) -> None:
     """Arm (None disarms) the collective watchdog process-wide — the
     programmatic spelling of ``THUNDER_TPU_COLLECTIVE_TIMEOUT_S``. A

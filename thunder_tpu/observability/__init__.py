@@ -37,6 +37,11 @@ from thunder_tpu.observability.events import EventLog, emit_event  # noqa: F401
 from thunder_tpu.observability.metrics import REGISTRY, MetricsRegistry  # noqa: F401
 
 _LAZY = {
+    "FlightRecorder": "thunder_tpu.observability.opsplane",
+    "OpsServer": "thunder_tpu.observability.opsplane",
+    "DetectorBank": "thunder_tpu.observability.detect",
+    "DetectorConfig": "thunder_tpu.observability.detect",
+    "HostHealthAccumulator": "thunder_tpu.observability.detect",
     "NaNWatcher": "thunder_tpu.observability.instrument",
     "NaNWatchError": "thunder_tpu.observability.instrument",
     "OpTimer": "thunder_tpu.observability.instrument",

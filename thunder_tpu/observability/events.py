@@ -23,6 +23,13 @@ merge deterministically (``scripts/lint_traces.py --events h0.jsonl h1.jsonl``).
 
 Kind-specific required fields live in ``thunder_tpu.analysis.events.SCHEMA``.
 Emission is a no-op costing one dict lookup when no log is active.
+
+Ops plane (ISSUE 15): when ``observability/opsplane`` is enabled it
+installs **taps** here — the flight-recorder ring and the streaming
+detector bank see every emitted record, with or without a JSONL log
+configured. With the plane off (the default) the taps tuple is empty and
+every emit path pays exactly one module-global truth test; the dispatch
+fast path emits nothing and pays nothing.
 """
 
 from __future__ import annotations
@@ -37,6 +44,63 @@ import time
 from typing import Any, Optional
 
 SCHEMA_VERSION = 1
+
+# -- ops-plane taps (observability/opsplane installs; empty = plane off) -------
+# A tuple of ``tap(kind, fields)`` callables that see every emitted record,
+# independent of whether a JSONL sink is configured — the flight recorder's
+# ring and the detector bank. One module-global truth test when empty.
+_ops: dict[str, Any] = {"taps": (), "recorder": None}
+
+
+def set_ops_taps(taps: tuple, *, recorder=None) -> None:
+    """Install (or clear, with ``()``) the ops-plane event taps. ``recorder``
+    is the flight recorder :func:`flight_dump` delegates to."""
+    _ops["taps"] = tuple(taps)
+    _ops["recorder"] = recorder
+
+
+def ops_active() -> bool:
+    return bool(_ops["taps"])
+
+
+def ops_taps() -> tuple:
+    """(taps, recorder) snapshot — for callers that need to restore the
+    installed taps around an A/B measurement (bench.py) without tearing
+    down a live ops plane's server."""
+    return _ops["taps"], _ops["recorder"]
+
+
+def _tap(kind: str, fields: dict) -> None:
+    for tap in _ops["taps"]:
+        try:
+            tap(kind, fields)
+        except Exception:
+            # The ops plane observes the workload; it must never take it
+            # down — a detector/recorder bug degrades to silence.
+            pass
+
+
+def tap_event(kind: str, fields: dict) -> None:
+    """Feed the ops taps directly — for emit sites that write through a
+    specific :class:`EventLog` handle (which taps on its own) but skip
+    emitting entirely when no log is configured; the flight recorder must
+    still see those records."""
+    if _ops["taps"]:
+        _tap(kind, fields)
+
+
+def flight_dump(reason: str = "manual"):
+    """Dump the installed flight recorder's ring (``flightrec-<ts>-
+    <reason>.jsonl``); None when the ops plane is off. The spelling fault
+    sites use (watchdog timeout, SDC exhaustion, autopilot halt, unhandled
+    dispatch faults) — one global probe when off, never raises."""
+    rec = _ops["recorder"]
+    if rec is None:
+        return None
+    try:
+        return rec.dump(reason)
+    except Exception:
+        return None
 
 
 _identity: dict[str, Any] = {}
@@ -88,6 +152,11 @@ class EventLog:
         self._dead = False
 
     def emit(self, kind: str, **fields) -> None:
+        # Ops-plane taps see the record whether or not the sink survives:
+        # the flight recorder is most valuable exactly when the disk log is
+        # dying underneath it.
+        if _ops["taps"]:
+            _tap(kind, fields)
         # Observability must never take the workload down: a sink I/O
         # failure (unwritable path, disk full) warns once and disables this
         # log instead of crashing the compile/training step it observes.
@@ -177,10 +246,14 @@ def active_log() -> Optional[EventLog]:
 
 def emit_event(kind: str, **fields) -> None:
     """Emit to the active log (contextvar override, else the global
-    THUNDER_TPU_EVENTS log); no-op when neither is configured."""
+    THUNDER_TPU_EVENTS log); no-op when neither is configured — except the
+    ops-plane taps, which see every record even with no log (the flight
+    recorder keeps context without paying full event logging)."""
     log = active_log()
     if log is not None:
-        log.emit(kind, **fields)
+        log.emit(kind, **fields)  # taps fire inside emit
+    elif _ops["taps"]:
+        _tap(kind, fields)
 
 
 def emit_compile_end(
@@ -194,11 +267,10 @@ def emit_compile_end(
     ``collective_bytes`` tags (stamped by executors/passes.py) become the
     event's executor and collective payloads."""
     log = active_log()
-    if log is None:
+    if log is None and not _ops["taps"]:
         return
     tags = getattr(trace, "tags", None) or {}
-    log.emit(
-        "compile_end",
+    fields = dict(
         compile_id=compile_id,
         fn=fn_name,
         ms=ms,
@@ -209,6 +281,12 @@ def emit_compile_end(
         recompile=recompile,
         staged=staged,
     )
+    if log is not None:
+        log.emit("compile_end", **fields)  # taps fire inside emit
+    else:
+        # No sink configured, ops plane on: the recompile-rate detector and
+        # the flight ring still need the record.
+        _tap("compile_end", fields)
 
 
 @contextlib.contextmanager

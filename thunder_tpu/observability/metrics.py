@@ -89,10 +89,22 @@ class _Metric:
 
 
 class Counter(_Metric):
-    """Monotonically increasing count (optionally labelled)."""
+    """Monotonically increasing count (optionally labelled).
+
+    ``always=True`` marks an *always-export* counter: its (unlabelled)
+    series appears in ``prometheus_text`` as an explicit 0 even before the
+    first increment and even with the metrics gate off — reserved for
+    counters whose absence would hide a loss of observability itself (the
+    event-log drop counter): a ``/healthz`` or scrape-side alert on
+    ``> 0`` only works if the 0 is on the wire to begin with (ISSUE 15
+    satellite)."""
 
     kind = "counter"
-    __slots__ = ()
+    __slots__ = ("always",)
+
+    def __init__(self, name: str, help: str = "", always: bool = False):
+        super().__init__(name, help)
+        self.always = bool(always)
 
     def inc(self, n: float = 1, **labels) -> None:
         if not _state["enabled"]:
@@ -211,8 +223,8 @@ class MetricsRegistry:
             )
         return m
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(Counter, name, help)
+    def counter(self, name: str, help: str = "", always: bool = False) -> Counter:
+        return self._get_or_create(Counter, name, help, always=always)
 
     def gauge(self, name: str, help: str = "") -> Gauge:
         return self._get_or_create(Gauge, name, help)
@@ -271,6 +283,12 @@ class MetricsRegistry:
             if m.help:
                 lines.append(f"# HELP {name} {m.help}")
             lines.append(f"# TYPE {name} {m.kind}")
+            if getattr(m, "always", False) and not m._values:
+                # Always-export counters put their 0 on the wire so the
+                # scrape side can alert on >0 (and /healthz can read the
+                # series) even before anything went wrong — and regardless
+                # of the metrics gate, matching inc_always (ISSUE 6/15).
+                lines.append(f"{name}{_label_str_prom(_label_key(extra))} 0")
             for k in list(m._values):
                 base = dict(extra, **dict(k))
                 lk = _label_str_prom(_label_key(base))
@@ -468,10 +486,33 @@ RESTORES = REGISTRY.counter(
     "Tiered checkpoint restores, labelled by winning tier "
     "(local|peer|disk)",
 )
-# inc_always: a dropped observability sink must be visible even with the
-# metrics gate off — silent loss of the event log is the failure mode this
-# counter exists to expose (monitor.report() lists it unconditionally).
+# inc_always + always-export: a dropped observability sink must be visible
+# even with the metrics gate off — silent loss of the event log is the
+# failure mode this counter exists to expose (monitor.report() lists it
+# unconditionally, prometheus_text puts its 0 on the wire so scrapers and
+# /healthz can degrade on the first drop — ISSUE 15 satellite).
 EVENT_LOG_DROPPED = REGISTRY.counter(
     "thunder_tpu_event_log_dropped_total",
     "Event-log sinks disabled after I/O failure (each loses all later events)",
+    always=True,
+)
+
+# -- live ops plane (ISSUE 15; docs/observability.md "ops plane") --------------
+
+OPS_REQUESTS = REGISTRY.counter(
+    "thunder_tpu_ops_requests_total",
+    "Ops-server HTTP requests, labelled by route "
+    "(/metrics|/healthz|/debug/state|/debug/flightrec)",
+)
+ANOMALIES = REGISTRY.counter(
+    "thunder_tpu_anomalies_total",
+    "Streaming-detector anomalies, labelled by kind "
+    "(step_time_drift|goodput_drop|recompile_storm|host_spread)",
+)
+# inc_always + always-export like the drop counter: a flight-recorder dump
+# means a fault fired — monitor.report() must show it with metrics off.
+FLIGHTREC_DUMPS = REGISTRY.counter(
+    "thunder_tpu_flightrec_dumps_total",
+    "Flight-recorder black-box dumps, labelled by trigger reason",
+    always=True,
 )

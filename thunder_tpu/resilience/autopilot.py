@@ -68,6 +68,7 @@ import contextlib
 import contextvars
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
@@ -202,6 +203,18 @@ class Autopilot:
         self._fires: dict = {}           # (kind, suspect) -> [ts, ...]
         self._health_strikes: dict = {}  # host -> consecutive flags
         self._flagged: set = set()       # hosts past the strike budget
+        # Streaming-detector anomalies (observability/detect.py), newest
+        # last; decide() cites the relevant one in its evidence so the soak
+        # can measure detection lead time (anomaly ts -> decision ts).
+        # Anomaly-earned straggler strikes live in their OWN time-windowed
+        # ledger (timestamps, pruned on read) — unlike the health ledger,
+        # no host_health summary ever runs to clear them, so they must
+        # decay on their own or a transient slowdown would flag a host for
+        # the rest of a week-long run.
+        self._anomalies: deque = deque(maxlen=64)
+        self._anomaly_strikes: dict = {}  # host -> [anomaly ts, ...]
+        self.anomaly_cite_window_s = 300.0
+        self.anomaly_strike_window_s = 600.0
         self._state_lock = threading.Lock()
         self._serial = threading.RLock()
         self._owner: Optional[int] = None
@@ -231,9 +244,85 @@ class Autopilot:
                     self._health_strikes.pop(host, None)
                     self._flagged.discard(host)
 
+    def _anomaly_flagged(self, now: Optional[float] = None) -> set:
+        """Hosts with >= health_strikes warn+ anomalies inside the strike
+        window. Pruned on read: anomaly flags DECAY — a host that stopped
+        drifting earns its gentle same-mesh rung back. Called under
+        _state_lock."""
+        now = time.time() if now is None else now
+        flagged = set()
+        for host, ts in list(self._anomaly_strikes.items()):
+            ts[:] = [t for t in ts if now - t <= self.anomaly_strike_window_s]
+            if not ts:
+                del self._anomaly_strikes[host]
+            elif len(ts) >= self.health_strikes:
+                flagged.add(host)
+        return flagged
+
     def flagged_stragglers(self) -> set:
         with self._state_lock:
-            return set(self._flagged)
+            return set(self._flagged) | self._anomaly_flagged()
+
+    def note_anomaly(self, anomaly: Optional[dict]) -> None:
+        """Consume one streaming-detector anomaly (ISSUE 15;
+        ``observability/detect.DetectorBank`` routes every verdict here
+        when an autopilot is installed). The anomaly joins the evidence
+        ring that :meth:`decide` cites, and a warn+ anomaly naming a
+        suspect host is a straggler strike: ``health_strikes`` of them
+        inside ``anomaly_strike_window_s`` flag the host exactly like
+        consecutive host_health summaries would — it loses the gentle
+        same-mesh rung on its next hang BEFORE a watchdog timeout ever
+        names it. The anomaly ledger is separate from the health one
+        (health summaries clear on recovery; anomaly strikes decay by
+        time) so the two feeders cannot erase each other's evidence."""
+        if not anomaly:
+            return
+        rec = dict(anomaly)
+        rec.setdefault("ts", time.time())
+        with self._state_lock:
+            self._anomalies.append(rec)
+            host = rec.get("suspect_host")
+            if host is not None and rec.get("severity") in ("warn", "critical"):
+                self._anomaly_strikes.setdefault(host, []).append(
+                    float(rec["ts"]))
+
+    # Which anomaly kinds are evidence for which signal kinds: a slow/
+    # drifting step backs the hang/loss ladders; a recompile storm backs
+    # the compile-pressure ladder.
+    _ANOMALY_RELEVANCE = {
+        "collective_hang": ("step_time_drift", "goodput_drop", "host_spread"),
+        "host_loss": ("step_time_drift", "goodput_drop", "host_spread"),
+        "host_unhealthy": ("step_time_drift", "goodput_drop", "host_spread"),
+        "oom": ("recompile_storm",),
+        "compile_fail": ("recompile_storm",),
+    }
+
+    def _cite_anomaly(self, signal: Signal) -> Optional[dict]:
+        """The newest relevant anomaly within the citation window (wall
+        clock — anomaly timestamps come from the detectors' ``time.time``),
+        host-matched when both sides name one. Called under _state_lock."""
+        kinds = self._ANOMALY_RELEVANCE.get(signal.kind)
+        if not kinds:
+            return None
+        now = time.time()
+        for rec in reversed(self._anomalies):
+            if rec.get("anomaly") not in kinds:
+                continue
+            if now - float(rec.get("ts") or 0.0) > self.anomaly_cite_window_s:
+                continue
+            a_host = rec.get("suspect_host")
+            if (signal.suspect_host is not None and a_host is not None
+                    and signal.suspect_host != a_host):
+                continue
+            return {
+                "anomaly": rec.get("anomaly"),
+                "severity": rec.get("severity"),
+                "ts": rec.get("ts"),
+                "value": rec.get("value"),
+                "baseline": rec.get("baseline"),
+                "suspect_host": a_host,
+            }
+        return None
 
     def signal_from_exception(self, exc: BaseException) -> Signal:
         """Normalize a fault exception raised out of the training loop."""
@@ -277,13 +366,22 @@ class Autopilot:
             hist[:] = [t for t in hist if now - t <= policy.window_s]
             rung = min(len(hist), len(policy.ladder) - 1)
             if (signal.kind == "collective_hang"
-                    and signal.suspect_host in self._flagged
+                    and (signal.suspect_host in self._flagged
+                         or signal.suspect_host in self._anomaly_flagged())
                     and rung == 0 and len(policy.ladder) > 1):
                 # The observatory already measured this host slow: skip the
                 # same-mesh retry rung, go straight to shrinking away.
                 rung = 1
             hist.append(now)
             actuator, mode = policy.ladder[rung]
+            # Cite the streaming-detector evidence (ISSUE 15): a decision
+            # whose fault the detectors saw coming carries the anomaly in
+            # its evidence — the soak's detection-lead-time join keys on
+            # exactly this (decision ts − cited anomaly ts).
+            cited = self._cite_anomaly(signal)
+            if cited is not None:
+                signal.evidence = dict(signal.evidence or {})
+                signal.evidence["anomaly"] = cited
             decision = Decision(
                 id=0, signal=signal, actuator=actuator,
                 mode=mode, rung=rung, fires_in_window=len(hist),
@@ -351,6 +449,29 @@ class Autopilot:
                 self._active_decision_id = None
             self._serial.release()
 
+    def debug_state(self, last: int = 16) -> dict:
+        """The ops-plane ``/debug/state`` view: live strike ladders, flagged
+        stragglers, recent anomalies, and the last ``last`` decisions."""
+        with self._state_lock:
+            return {
+                "strikes": {
+                    f"{kind}@{host}": len(ts)
+                    for (kind, host), ts in sorted(
+                        self._fires.items(), key=lambda kv: str(kv[0]))
+                    if ts
+                },
+                "flagged_stragglers": sorted(
+                    set(self._flagged) | self._anomaly_flagged(), key=str),
+                "anomalies": list(self._anomalies)[-last:],
+                "decisions": [
+                    {"id": d.id, "signal": d.signal.kind,
+                     "actuator": d.actuator, "mode": d.mode, "rung": d.rung,
+                     "suspect_host": d.signal.suspect_host}
+                    for d in self.decisions[-last:]
+                ],
+                "serialized_waits": self._serialized_waits,
+            }
+
     def stats(self) -> dict:
         """Decision/recovery accounting for reports and tests."""
         by_actuator: dict[str, int] = {}
@@ -361,7 +482,7 @@ class Autopilot:
             "by_actuator": by_actuator,
             "recoveries": len(self.recovery_intervals),
             "serialized_waits": self._serialized_waits,
-            "flagged_stragglers": sorted(self._flagged, key=str),
+            "flagged_stragglers": sorted(self.flagged_stragglers(), key=str),
         }
 
     # -- installation ---------------------------------------------------------
@@ -568,6 +689,9 @@ def run_autopiloted_training(
                 report.decisions = list(autopilot.decisions)
                 report.halted = AutopilotHalt(e.step, "preemption", None)
                 report.halted.report = report
+                # Black-box dump (ISSUE 15): every halt leaves the ring's
+                # preceding context on disk next to the durable checkpoint.
+                obs_events.flight_dump("autopilot_halt")
                 raise report.halted from e
             except (HostLost, CollectiveTimeoutError, SDCDetectedError) as e:
                 if report.recoveries >= max_recoveries:
@@ -585,6 +709,7 @@ def run_autopiloted_training(
                         signal.step or 0, f"policy ladder exhausted for "
                         f"{signal.kind}", decision)
                     report.halted.report = report
+                    obs_events.flight_dump("autopilot_halt")
                     raise report.halted from e
                 if decision.mode == "shrink":
                     new_shape = shrink_shape(cur_shape)
@@ -598,6 +723,7 @@ def run_autopiloted_training(
                         report.halted = AutopilotHalt(
                             signal.step or 0, "mesh exhausted", decision)
                         report.halted.report = report
+                        obs_events.flight_dump("autopilot_halt")
                         raise report.halted from e
                     _elastic(decision, _make_mesh(new_shape), new_shape)
                     shrunk_at = start

@@ -458,12 +458,37 @@ def run_seam(has_collectives: bool = False, deopt_level: int = 0) -> None:
     for rule in cfg.rules_for("straggler"):
         if rule.exhausted() or not rule.host_matches():
             continue
+        if rule.target == "step":
+            continue  # guarded-step-only rules fire in straggler_seam()
         if rule.target != "any" and not has_collectives:
             continue
         if rule.prob < 1.0 and cfg.rng.random() >= rule.prob:
             continue
         rule.fired += 1
         _record(rule, rule.target)
+        time.sleep(rule.delay_s)
+
+
+def straggler_seam(site: str = "step") -> None:
+    """Step-path straggler delay (watchdog.guard_call's worker body): an
+    armed ``straggler@step`` rule sleeps ``~<delay>`` seconds inside the
+    guarded step — a host slowing down WITHOUT hanging, the drift the
+    streaming detectors (observability/detect.py) must flag before the
+    watchdog's timeout would. Rules targeting ``any`` (or untargeted) fire
+    here too; the dispatch-path straggler in :func:`run_seam` ignores the
+    ``step`` target, so the two sites never double-fire a targeted rule."""
+    cfg = active()
+    if cfg is None:
+        return
+    for rule in cfg.rules_for("straggler"):
+        if rule.exhausted() or not rule.host_matches():
+            continue
+        if rule.target not in (None, "any", site):
+            continue
+        if rule.prob < 1.0 and cfg.rng.random() >= rule.prob:
+            continue
+        rule.fired += 1
+        _record(rule, site)
         time.sleep(rule.delay_s)
 
 

@@ -81,6 +81,21 @@ def current_level(cd) -> int:
     return getattr(cd, "_deopt_level", 0)
 
 
+# Process-wide high-water mark of the ladder: any function de-opted means
+# this process is trading speed for survival — the /healthz deopt component
+# (observability/opsplane.py) reads it without enumerating CompileDatas.
+_process_state = {"max_level": 0}
+
+
+def process_max_level() -> int:
+    return _process_state["max_level"]
+
+
+def reset_process_state() -> None:
+    """Tests only: the high-water mark is process-wide by design."""
+    _process_state["max_level"] = 0
+
+
 def _planned_peaks(entry, cs, cd=None):
     """(predicted per-level peak bytes, device capacity bytes) for the
     failing entry's claimed trace — the static liveness planner's input to
@@ -189,6 +204,8 @@ def escalate(cd, reason: str, attempt: int, *, entry=None, cs=None) -> bool:
         ctx = ap.recovery(decision)
     with ctx:
         cd._deopt_level = level
+        if level > _process_state["max_level"]:
+            _process_state["max_level"] = level
         backoff = _backoff_s(attempt)
         if obsm.enabled():
             obsm.COMPILE_DEOPTS.inc(level=str(level))

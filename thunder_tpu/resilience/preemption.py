@@ -37,6 +37,7 @@ import shutil
 import signal
 import threading
 import time
+import weakref
 from typing import Any, Callable, Optional
 
 from thunder_tpu.observability import events as obs_events
@@ -152,6 +153,32 @@ def _multihost_any(local: bool) -> bool:
     return local
 
 
+# Live managers, weakly held — the ops plane's /healthz reads each one's
+# in-flight background-flush state (a flush stuck on a dying disk is a
+# durability incident the operator must see before the next preemption
+# needs that checkpoint). WeakSet: registration must not keep a test's
+# throwaway manager (and its writer thread) alive.
+_managers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def inflight_flushes() -> list[dict]:
+    """Background flushes currently in flight across every live
+    :class:`CheckpointManager`: ``[{directory, step, for_s}]`` — the
+    ``/healthz`` checkpoint component (observability/opsplane.py)."""
+    out = []
+    now = time.monotonic()
+    for mgr in list(_managers):
+        step = mgr._inflight_step
+        since = mgr._inflight_since
+        if step is not None:
+            out.append({
+                "directory": mgr.directory,
+                "step": int(step),
+                "for_s": round(now - since, 3) if since is not None else 0.0,
+            })
+    return out
+
+
 class PreemptionGuard:
     """SIGTERM-triggered stop flag with multihost agreement.
 
@@ -253,10 +280,12 @@ class CheckpointManager:
         self._flush_cv = threading.Condition()
         self._pending: Optional[tuple] = None  # (Snapshot, Context)
         self._inflight_step: Optional[int] = None
+        self._inflight_since: Optional[float] = None
         self._coalesced = 0
         self._writer: Optional[threading.Thread] = None
         self._stop = False
         os.makedirs(self.directory, exist_ok=True)
+        _managers.add(self)
 
     # -- paths ----------------------------------------------------------------
 
@@ -499,6 +528,7 @@ class CheckpointManager:
                 snap, ctx = self._pending
                 self._pending = None
                 self._inflight_step = snap.step
+                self._inflight_since = time.monotonic()
                 coalesced, self._coalesced = self._coalesced, 0
             try:
                 ctx.run(self._flush_one, snap, coalesced=coalesced)
@@ -510,6 +540,7 @@ class CheckpointManager:
             finally:
                 with self._flush_cv:
                     self._inflight_step = None
+                    self._inflight_since = None
                     self._flush_cv.notify_all()
 
     def _flush_one(self, snap, *, coalesced: int = 0, sync: bool = False) -> None:
@@ -987,4 +1018,7 @@ def _sdc_check_and_rerun(sdc, run_step, prev_state, state, loss, step):
             )
             if ok:
                 return state, loss
+    # Flight-recorder dump (ISSUE 15): persistent corruption is about to
+    # raise — the ring holds the sdc_suspect/sdc_rerun chain that led here.
+    obs_events.flight_dump("sdc")
     raise SDCDetectedError(step, sorted(divergence))

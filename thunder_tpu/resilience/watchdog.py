@@ -229,6 +229,11 @@ def guard_call(
         try:
             def body():
                 chaos.collective_hang_seam()
+                # Sub-timeout slowdown (straggler@step): the streaming
+                # detectors (observability/detect.py) must see a drifting
+                # step BEFORE it becomes a hang — this is the seam the soak
+                # uses to prove detection lead time (ISSUE 15).
+                chaos.straggler_seam("step")
                 return fn(*args, **(kwargs or {}))
 
             box["out"] = ctx.run(body)
@@ -254,6 +259,10 @@ def guard_call(
             "collective_timeout", fn=fn_name, timeout_s=timeout,
             lines=lines, suspected_host=suspect, **extra,
         )
+        # Black-box dump (ISSUE 15): the ring already holds the fault's
+        # preceding context (step timings, injections, the timeout record
+        # above) — capture it before the raise unwinds the stack.
+        obs_events.flight_dump("collective_timeout")
         raise CollectiveTimeoutError(fn_name, timeout, lines, suspect, schedule)
     if "exc" in box:
         raise box["exc"]
