@@ -65,6 +65,15 @@ Usage:
         # schema-valid flight-recorder dump, and the measured ops-plane
         # overhead must stay under 1% of the step time (the same
         # composition bench.py records as ops_overhead_pct)
+    python scripts/lint_traces.py --hlo
+        # HLO-auditor smoke (ISSUE 16; docs/trace_invariants.md "HLO
+        # auditor"): the fsdp4·tp2 build_train_step executable's compiled
+        # HLO must yield ≥1 partitioner-inserted collective of every
+        # family the partitioner emits (all-gather, all-reduce, derived
+        # reduce-scatter, collective-permute) with nonzero wire bytes, a
+        # schema-valid report JSON, analyze cost <5% of the XLA compile,
+        # and garbage HLO must degrade to a sharp_edge advisory without
+        # breaking the compile
     python scripts/lint_traces.py --chaos-multihost
         # mesh-wide resilience smoke (ISSUE 9): the FSDP×TP training step
         # on a virtual 8-device mesh under a canned host-loss +
@@ -276,6 +285,174 @@ def _multichip_smoke() -> int:
 
     n_errors += _bench_history_gate("MULTICHIP_BENCH_r*.json")
     print(f"\nlint_traces --multichip: {n_errors} error(s)")
+    return n_errors
+
+
+def _hlo_smoke() -> int:
+    """--hlo: re-exec this script on a virtual 8-device CPU mesh (the
+    device-count flag must be set before jax initializes) and run
+    :func:`_hlo_inner` there. Returns the error count."""
+    import subprocess
+
+    env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": os.environ.get("HOME", "/root"),
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "THUNDER_TPU_RETRY_BACKOFF_S": "0",
+    }
+    cmd = [sys.executable, os.path.abspath(__file__), "--_hlo-inner"]
+    print("--- hlo smoke (subprocess, 8 virtual devices)")
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True, timeout=1200)
+    out = (r.stdout + r.stderr).strip().splitlines()
+    for line in out[-40:]:
+        print(f"    {line}")
+    if r.returncode != 0:
+        print(f"    FAILED: inner smoke exited {r.returncode}")
+        return 1
+    return 0
+
+
+# Every key one committed HloScheduleReport.to_json() must carry for the
+# static series (bench r05+, docs/performance.md "static HLO audit") to stay
+# comparable.
+_HLO_REPORT_REQUIRED_KEYS = (
+    "v", "module", "device", "n_ops", "n_computations", "collectives",
+    "inserted_collectives", "explicit_collectives", "fusions", "layout_copies",
+    "host_transfers", "flops", "hbm_bytes", "comm_bytes", "compute_us",
+    "wire_us", "hidden_us", "exposed_us", "exposed_pct", "sites",
+)
+_HLO_SITE_REQUIRED_KEYS = (
+    "name", "opcode", "family", "computation", "group_size", "wire_bytes",
+    "wire_us", "hidden_us", "exposed_us", "inserted", "derived",
+)
+
+
+def _hlo_inner() -> int:
+    """The HLO-auditor smoke (ISSUE 16 acceptance), run with 8 virtual
+    devices: the fsdp4·tp2 ``build_train_step`` executable's compiled HLO
+    must yield ≥1 partitioner-inserted collective of every family the
+    partitioner emits on this step (all-gather, all-reduce, reduce-scatter
+    — CPU XLA spells it as all-reduce+shard-slice, recovered as derived —
+    and collective-permute), each with nonzero wire bytes; the report's
+    ``to_json()`` must be schema-valid; the analyze pass must cost <5% of
+    the XLA compile it piggybacks on; garbage HLO must raise ``ValueError``
+    from ``audit_hlo`` and, through the compile-phase path, degrade to a
+    ``sharp_edge`` advisory with the compile unharmed."""
+    import json
+    import tempfile
+    import time
+
+    import numpy as np
+
+    import thunder_tpu as ttpu
+    from thunder_tpu.analysis import hlo_audit
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.parallel import build_train_step, make_mesh
+    from thunder_tpu.parallel.sharding import gpt_param_specs
+
+    n_errors = 0
+    cfg = m.name_to_config("gpt-tiny")
+    params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+
+    print("--- hlo smoke: audit the fsdp4-tp2 build_train_step executable")
+    mesh = make_mesh(fsdp=4, tp=2)
+    step, opt0 = build_train_step(
+        cfg, params, idx, tgt, mesh=mesh, param_specs=gpt_param_specs(cfg, mesh),
+        lr=1e-2, executors=["jax"], donate=False,
+    )
+    t0 = time.perf_counter()
+    text = step.lower(params, opt0, idx, tgt).compile().as_text()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    rep = hlo_audit.audit_hlo(text)
+    analyze_s = time.perf_counter() - t0
+
+    # Family coverage: the ISSUE 16 acceptance families, each inserted by
+    # the partitioner (not explicit dist_prims) and carrying wire bytes.
+    expected = ("all-gather", "all-reduce", "reduce-scatter", "collective-permute")
+    bad = [f for f in expected
+           if not ((agg := rep.by_family.get(f))
+                   and agg["count"] >= 1 and agg["wire_bytes"] > 0
+                   and agg["inserted"] >= 1)]
+    if bad:
+        n_errors += 1
+        print(f"    FAILED: families missing/uninserted/zero-wire: {bad} "
+              f"(got {sorted(rep.by_family)})")
+    else:
+        derived_rs = sum(1 for s in rep.sites if s.family == "reduce-scatter"
+                         and s.derived)
+        print("    families OK: " + ", ".join(
+            f"{f}×{rep.by_family[f]['count']}" for f in expected)
+            + f" ({rep.inserted_collectives} inserted, {derived_rs} derived "
+            f"reduce-scatter), static exposed {rep.exposed_pct:.1f}%")
+
+    js = rep.to_json()
+    missing = [k for k in _HLO_REPORT_REQUIRED_KEYS if k not in js]
+    site_missing = [k for k in _HLO_SITE_REQUIRED_KEYS
+                    for s in js["sites"][:1] if k not in s]
+    json.dumps(js)  # must be JSON-serializable end to end
+    if missing or site_missing or not js["sites"]:
+        n_errors += 1
+        print(f"    FAILED: report schema (missing={missing}, "
+              f"site_missing={site_missing}, sites={len(js['sites'])})")
+    else:
+        print(f"    schema OK: {len(_HLO_REPORT_REQUIRED_KEYS)} report keys, "
+              f"{len(js['sites'])} sites serialized")
+
+    if analyze_s >= 0.05 * compile_s:
+        n_errors += 1
+        print(f"    FAILED: analyze {analyze_s * 1e3:.0f}ms >= 5% of the "
+              f"{compile_s:.2f}s XLA compile it piggybacks on")
+    else:
+        print(f"    overhead OK: analyze {analyze_s * 1e3:.0f}ms = "
+              f"{analyze_s / compile_s * 100:.1f}% of the {compile_s:.2f}s "
+              f"XLA compile (< 5%)")
+
+    print("--- hlo smoke: garbage HLO degrades to a sharp_edge advisory")
+    try:
+        hlo_audit.audit_hlo("this is not an HLO module at all")
+        n_errors += 1
+        print("    FAILED: audit_hlo accepted garbage without a ValueError")
+    except ValueError:
+        pass
+
+    # The compile-phase path: seed the same failure INSIDE the auditor the
+    # api.py phase calls; the compile must succeed, the result must be
+    # right, and the event log must carry the advisory sharp_edge.
+    log = os.path.join(tempfile.mkdtemp(prefix="ttpu_hlo_"), "events.jsonl")
+    real_parse = hlo_audit.parse_hlo_module
+    hlo_audit.parse_hlo_module = lambda text: real_parse("seeded garbage")
+    try:
+        jf = ttpu.jit(lambda a: (a * 2.0).sum(), executors=["jax"], events=log)
+        out = float(np.asarray(jf(np.ones((4, 4), np.float32))))
+    except Exception as e:  # noqa: BLE001 — an escaped auditor error IS the failure
+        n_errors += 1
+        out = None
+        print(f"    FAILED: corrupted auditor broke the compile: "
+              f"{type(e).__name__}: {e}")
+    finally:
+        hlo_audit.parse_hlo_module = real_parse
+    recs = [json.loads(l) for l in open(log)] if os.path.exists(log) else []
+    advisory = [r for r in recs if r.get("kind") == "sharp_edge"
+                and "hlo_audit failed (advisory)" in (r.get("message") or "")]
+    if out is not None and out != 32.0:
+        n_errors += 1
+        print(f"    FAILED: compile under corrupted auditor returned {out}")
+    elif out is not None and not advisory:
+        n_errors += 1
+        print(f"    FAILED: no advisory sharp_edge in the event log "
+              f"(kinds={sorted({r.get('kind') for r in recs})})")
+    elif out is not None:
+        print("    advisory OK: compile unharmed (result exact), sharp_edge "
+              f"recorded: {advisory[0]['message'][:72]}")
+
+    print(f"\nlint_traces --hlo: {n_errors} error(s)")
     return n_errors
 
 
@@ -1464,7 +1641,7 @@ def _chaos_multihost_inner() -> int:
 
 
 _USAGE = ("usage: lint_traces.py [pattern] | --static | --schedule | --chaos | "
-          "--chaos-multihost | --multichip | --soak | "
+          "--chaos-multihost | --multichip | --soak | --hlo | "
           "--events <log.jsonl> [...] [--storm-threshold N]")
 
 
@@ -1473,6 +1650,12 @@ def main(argv=None) -> int:
 
     if "--_chaos-multihost-inner" in argv:
         return 1 if _chaos_multihost_inner() else 0
+
+    if "--_hlo-inner" in argv:
+        return 1 if _hlo_inner() else 0
+
+    if "--hlo" in argv:
+        return 1 if _hlo_smoke() else 0
 
     if "--chaos-multihost" in argv:
         return 1 if _chaos_multihost_smoke() else 0

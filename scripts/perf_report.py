@@ -61,7 +61,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # reported in the trajectory but never gated.
 _HIGHER_SUBSTRINGS = ("mfu", "vs_baseline", "tokens_per_sec", "dots_passed",
                       "goodput")
-_LOWER_SUFFIXES = ("_s", "_us", "_ms", "_pct", "_seconds", "_ms_per_step")
+_LOWER_SUFFIXES = ("_s", "_us", "_ms", "_pct", "_pct_static", "_seconds", "_ms_per_step")
 _LOWER_EXACT = {"value", "recompile_count"}
 
 # Absolute-delta floors (same units as the metric): second-scale pipeline
@@ -98,6 +98,11 @@ _MULTICHIP_NOISE_FLOORS = (
     # The snapshot stall is a host gather of a tiny model on a contended
     # CPU — a few ms of scheduler jitter is noise (ISSUE 14).
     ("stall_ms_per_step", 3.0),
+    # Static exposed-collective % from the HLO auditor (ISSUE 16) is
+    # deterministic given the HLO, but XLA fusion decisions wobble a little
+    # across versions/flags; a couple of points is not a scheduling
+    # regression.
+    ("exposed_pct_static", 2.0),
 )
 
 # SOAK_r* rounds (headline metric "soak_goodput"): goodput on the emulated

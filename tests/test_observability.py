@@ -180,13 +180,20 @@ class TestEventLog:
                 "compile_id", "fn", "ms", "n_bsyms", "claims",
                 "collective_bytes", "symbolic", "recompile", "staged",
             },
-            # Optional fields: cache (hit|miss verdict on xla_compile) and
-            # the static_analysis span's planner summary (ISSUE 10:
-            # predicted_peak_bytes + collective_sites); sub-spans carry the
-            # bare triple.
+            # Optional fields: cache (hit|miss verdict on xla_compile), the
+            # static_analysis span's planner summary (ISSUE 10:
+            # predicted_peak_bytes + collective_sites), and the hlo_audit
+            # span's auditor summary (ISSUE 16 — present by-presence: an
+            # absent field means the audit had nothing to say there);
+            # sub-spans carry the bare triple.
             "compile_phase": envelope | {"compile_id", "phase", "s"},
         }
-        phase_optional = {"cache", "predicted_peak_bytes", "collective_sites"}
+        phase_optional = {
+            "cache", "predicted_peak_bytes", "collective_sites",
+            # hlo_audit (ISSUE 16)
+            "hlo_ops", "hlo_acquire_s", "hlo_analyze_s", "hlo_collectives",
+            "hlo_inserted_collectives", "hlo_exposed_pct", "hlo_host_transfers",
+        }
         for r in recs:
             want = golden[r["kind"]]
             got = set(r) - (phase_optional if r["kind"] == "compile_phase" else set())

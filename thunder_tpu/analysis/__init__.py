@@ -16,6 +16,9 @@ rules checks the invariants every transform pass must preserve —
 - ``mem.*``           predicted peak HBM vs device capacity (liveness planner)
 - ``sched.*``         per-axis collective ordering vs the stamped schedule
                       certificate
+- ``hlo.*``           compiled-HLO findings (partitioner-inserted exposed
+                      collectives, layout copies, padding waste, host
+                      transfers) from the post-compile auditor (hlo_audit.py)
 
 Pipeline wiring: with ``THUNDER_TPU_CHECKS=1`` (or ``jit(debug_checks=True)``)
 every pass's ``wrap_in_trace_provenance``/``mark`` runs :func:`verify_or_raise`
@@ -51,6 +54,13 @@ from thunder_tpu.analysis.cost import (  # noqa: F401
     trace_cost,
 )
 from thunder_tpu.analysis.events import format_replay, merge_event_logs, replay_events  # noqa: F401
+from thunder_tpu.analysis.hlo_audit import (  # noqa: F401
+    HloCollectiveSite,
+    HloScheduleReport,
+    audit_hlo,
+    audit_jitted,
+    parse_hlo_module,
+)
 from thunder_tpu.analysis.liveness import (  # noqa: F401
     MemoryPlan,
     arg_divisors_from_specs,

@@ -607,3 +607,102 @@ def cost_report(fn: Callable, *args, executors: Any = None, device: Any = None,
         comp = cse(dce(comp))
         extrace = transform_for_execution(comp, resolve_executors(executors))
     return trace_cost(extrace, device)
+
+
+# =============================================================================
+# HLO-op pricing (the compiled-executable twin of bsym_cost)
+# =============================================================================
+
+# Ring-collective wire-traffic factors by HLO family name — the compiled-HLO
+# counterpart of _COLLECTIVE_FACTORS (keyed by trace sym name above). The
+# derived reduce-scatter (an all-reduce whose consumers all slice a shard,
+# recovered by analysis/hlo_audit) prices at the reduce-scatter factor: the
+# program provably needs only the scattered result.
+HLO_COLLECTIVE_FACTORS: dict[str, Callable[[int], float]] = {
+    "all-reduce": lambda g: 2.0 * (g - 1) / g,
+    "all-gather": lambda g: (g - 1) / g,
+    "reduce-scatter": lambda g: (g - 1) / g,
+    "collective-broadcast": lambda g: (g - 1) / g,
+    "all-to-all": lambda g: (g - 1) / g,
+    "ragged-all-to-all": lambda g: (g - 1) / g,
+    "collective-permute": lambda g: 1.0,
+}
+
+
+def hlo_collective_wire_bytes(family: str, full_bytes: float, group_size: int) -> float:
+    """Ring wire traffic of one HLO collective: the family factor applied to
+    the FULL tensor bytes (gather output / reduce input — the caller picks
+    the full side, :func:`hlo_op_cost` does for parsed ops)."""
+    factor_fn = HLO_COLLECTIVE_FACTORS.get(family)
+    if factor_fn is None or group_size <= 1:
+        return full_bytes if factor_fn is not None else 0.0
+    return factor_fn(group_size) * full_bytes
+
+
+# Opcode classes, mirroring the bsym conventions in the module docstring:
+# layout-only ops are free (XLA fuses them), data movers are charged in+out
+# bytes at 0 FLOPs, elementwise is 1 FLOP per output element, reductions
+# 1 FLOP per input element. Call-like ops are free at the call site — their
+# bodies are priced standalone (or folded into the fusion) by the auditor.
+_HLO_FREE_OPS = frozenset({
+    "parameter", "constant", "iota", "bitcast", "bitcast-convert", "reshape",
+    "broadcast", "get-tuple-element", "tuple", "after-all", "partition-id",
+    "replica-id", "domain", "opt-barrier", "while", "call", "conditional",
+    "custom-call", "rng-get-and-update-state", "get-dimension-size",
+    "add-dependency", "token",
+})
+_HLO_MOVE_OPS = frozenset({
+    "slice", "dynamic-slice", "dynamic-update-slice", "concatenate", "pad",
+    "gather", "transpose", "reverse", "copy", "copy-start", "copy-done",
+    "send", "recv", "send-done", "recv-done", "infeed", "outfeed",
+})
+_HLO_REDUCE_OPS = frozenset({"reduce", "reduce-window", "scatter", "sort", "select-and-scatter"})
+
+
+def hlo_op_cost(op: Any, *, inner_flops: float = 0.0) -> Optional[OpCost]:
+    """Static cost of one parsed HLO instruction — the HLO-op → FLOPs/HBM/ICI
+    rules the auditor (analysis/hlo_audit.py) prices every compiled op with.
+
+    ``op`` is duck-typed (:class:`~thunder_tpu.analysis.hlo_audit.HloOp`):
+    ``opcode``, ``result_bytes``/``result_numel``, ``operand_bytes``/
+    ``operand_numel``, ``group_size``, ``k_dim`` (dot/conv contraction size),
+    ``family`` (collective family after classification, None otherwise).
+    ``inner_flops`` carries a fusion body's summed FLOPs — the fusion is
+    charged its boundary bytes plus the body's arithmetic, and the body's
+    ops are NOT priced standalone (hlo_audit skips fusion-called
+    computations). Returns None for `-done` completion halves (their
+    `-start` op carries the cost)."""
+    opcode = op.opcode
+    fam = getattr(op, "family", None) or (
+        opcode[:-6] if opcode.endswith("-start") and opcode[:-6] in HLO_COLLECTIVE_FACTORS
+        else opcode if opcode in HLO_COLLECTIVE_FACTORS else None
+    )
+    if fam is not None:
+        if opcode.endswith("-done"):
+            return None
+        # The ring moves (g−1)/g of the FULL tensor: the gathered output for
+        # all-gather (result is full), the reduced input for a native
+        # reduce-scatter (operand is full); all-reduce and the derived
+        # reduce-scatter have out == in == full.
+        full = op.operand_bytes if opcode.startswith("reduce-scatter") else op.result_bytes
+        return OpCost(
+            comm_bytes=hlo_collective_wire_bytes(fam, full, max(1, int(op.group_size))),
+            kind="collective",
+        )
+    io = op.operand_bytes + op.result_bytes
+    if opcode == "fusion":
+        return OpCost(flops=inner_flops, bytes_moved=io, kind="fusion")
+    if opcode == "dot":
+        return OpCost(flops=2.0 * op.result_numel * max(1.0, op.k_dim),
+                      bytes_moved=io, kind="matmul")
+    if opcode == "convolution":
+        return OpCost(flops=2.0 * op.result_numel * max(1.0, op.k_dim),
+                      bytes_moved=io, kind="matmul")
+    if opcode in _HLO_FREE_OPS:
+        return None
+    if opcode in _HLO_MOVE_OPS:
+        return OpCost(bytes_moved=io, kind="layout" if opcode.startswith("copy") else "shape")
+    if opcode in _HLO_REDUCE_OPS:
+        return OpCost(flops=op.operand_numel, bytes_moved=io, kind="reduction")
+    # Everything else prices as elementwise: 1 FLOP per output element.
+    return OpCost(flops=op.result_numel, bytes_moved=io, kind="elementwise")

@@ -1061,6 +1061,19 @@ def _run_entry(entry: CacheEntry, flat_inps: tuple, prepared=None) -> Any:
         ]
     if entry.needs_rng:
         inps = inps + [_next_key()]
+    if getattr(entry, "_hlo_audit_pending", False):
+        # First run of a fresh entry: snapshot the staged callable's input
+        # avals so the post-compile HLO auditor (_maybe_hlo_audit) can
+        # re-lower without holding references to (possibly donated) buffers.
+        entry._hlo_audit_pending = False
+        try:
+            import jax
+
+            entry.hlo_audit_avals = tuple(
+                jax.ShapeDtypeStruct(tuple(x.shape), x.dtype) for x in inps
+            )
+        except Exception:  # noqa: BLE001 — advisory capture only
+            entry.hlo_audit_avals = None
     if chaos_mod.enabled():
         # Chaos seams: injected device OOM (recovered by the de-opt ladder)
         # and the collective-straggler delay. One contextvar probe when
@@ -1108,6 +1121,75 @@ def _run_entry(entry: CacheEntry, flat_inps: tuple, prepared=None) -> Any:
 
         out = tree_map(lambda x: bridge.to_torch(x) if isinstance(x, jax.Array) else x, out)
     return out
+
+
+def _hlo_audit_enabled() -> bool:
+    import os
+
+    return os.environ.get("THUNDER_TPU_HLO_AUDIT", "1").strip().lower() not in (
+        "0", "false", "off",
+    )
+
+
+def _bucket_pad_fractions(entry: CacheEntry) -> dict:
+    """Bucket class label → padded-away fraction (1 − true/padded extent) of
+    a symbolic entry's last dispatch — the ``hlo.padding-waste`` rule input."""
+    spec = entry.sym_spec
+    true_ext = getattr(entry, "last_true_extents", None)
+    if spec is None or not true_ext:
+        return {}
+    out: dict = {}
+    for cid, (li, d, _lo, hi) in spec.classes.items():
+        t = true_ext.get(cid)
+        if t is None or hi <= 0:
+            continue
+        out[f"leaf{li}.dim{d}"] = round(max(0.0, 1.0 - t / hi), 4)
+    return out
+
+
+def _maybe_hlo_audit(entry: CacheEntry, log=None) -> None:
+    """Post-``xla_compile`` compile phase: audit the entry's compiled HLO
+    (analysis/hlo_audit.py) — partitioner-inserted collectives, layout
+    copies, host transfers, static exposed-wire — and attach the report to
+    the entry and the extrace tags (``hlo_audit``), where the advisory
+    ``hlo.*`` verifier rules read it. Advisory-safe by contract: any
+    auditor failure emits a ``sharp_edge`` and never breaks the compile;
+    ``THUNDER_TPU_HLO_AUDIT=0`` is the kill switch."""
+    import time as _time
+
+    avals = getattr(entry, "hlo_audit_avals", None)
+    jfn = entry.computation_fn
+    if not avals or jfn is None or not hasattr(jfn, "lower"):
+        return
+    t0 = _time.perf_counter()
+    try:
+        from thunder_tpu.analysis import hlo_audit as _hlo_audit_mod
+
+        text = jfn.lower(*avals).compile().as_text()
+        acquire_s = _time.perf_counter() - t0
+        report = _hlo_audit_mod.audit_hlo(text, pad_fractions=_bucket_pad_fractions(entry))
+        total_s = _time.perf_counter() - t0
+        report.audit_s = total_s
+        entry.hlo_audit = report
+        if entry.computation_traces:
+            entry.computation_traces[-1].tags["hlo_audit"] = report
+        entry.stats.phases["hlo_audit"] = total_s
+        # Optional fields by PRESENCE (PR 10 discipline): an absent field
+        # means the audit had nothing to say there, not zero.
+        extra: dict = dict(
+            hlo_ops=report.n_ops,
+            hlo_acquire_s=round(acquire_s, 6),
+            hlo_analyze_s=round(total_s - acquire_s, 6),
+        )
+        if report.sites:
+            extra["hlo_collectives"] = len(report.sites)
+            extra["hlo_inserted_collectives"] = report.inserted_collectives
+            extra["hlo_exposed_pct"] = round(report.exposed_pct, 2)
+        if report.host_transfers:
+            extra["hlo_host_transfers"] = report.host_transfers
+        _record_compile_phase(entry.compile_id, "hlo_audit", total_s, log=log, **extra)
+    except Exception as e:  # noqa: BLE001 — the auditor must never break a compile
+        sharp_edge(f"hlo_audit failed (advisory): {type(e).__name__}: {e}")
 
 
 # =============================================================================
@@ -1750,6 +1832,11 @@ def jit(
             cs.prologue_runs += 1
             entry.stats.prologue_runs += 1
             flat_inps = entry.prologue_fn(*args, **kwargs)
+            # Aval capture is unconditional (one-time, bytes-cheap) so
+            # examine.hlo_report can audit on demand even when the
+            # compile-time phase is disabled; only the audit itself gates
+            # on THUNDER_TPU_HLO_AUDIT.
+            entry._hlo_audit_pending = True
             jax_compile0 = _jax_cache_counts()
             run_start = timer_ns()
             try:
@@ -1791,6 +1878,8 @@ def jit(
             entry.compile_id, "xla_compile", entry.stats.first_run_s,
             log=_entry_log, cache=cache_verdict,
         )
+        if _hlo_audit_enabled():
+            _maybe_hlo_audit(entry, log=_entry_log)
         if obsm.enabled():
             # The entry's first run is where jax.jit actually compiles: this
             # is the end-to-end XLA compile cost per compile class — the

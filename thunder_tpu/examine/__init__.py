@@ -175,6 +175,64 @@ def lint(fn: Callable, *args, executors: Optional[Any] = None, verbose: bool = T
     return diagnostics
 
 
+def hlo_report(fn: Callable, *args, device: Optional[Any] = None,
+               verbose: bool = True, **kwargs):
+    """Audit the compiled-HLO executable behind ``fn`` — the static view of
+    what the XLA SPMD partitioner actually emitted (partitioner-inserted
+    collectives, fusions, layout copies, host transfers, exposed wire time),
+    which no trace-level tool can see (ROADMAP item 3).
+
+    Accepts, in order of preference:
+
+    - a ``thunder_tpu.jit``-compiled function: returns the report the
+      ``hlo_audit`` compile phase attached to its latest cache entry,
+      compiling on the example args first if needed;
+    - an already-jitted jax callable (``jax.jit`` object or AOT
+      ``Compiled``) — e.g. the ``build_train_step`` pjit step function:
+      lowered and audited on the example args;
+    - a plain callable: compiled through ``thunder_tpu.jit`` first.
+
+    Returns the :class:`~thunder_tpu.analysis.hlo_audit.HloScheduleReport`;
+    with ``verbose`` pretty-prints it plus the advisory ``hlo.*`` findings.
+    Docs: docs/performance.md (§HLO auditor)."""
+    from thunder_tpu.analysis.hlo_audit import audit_jitted
+
+    report = None
+    cs = getattr(fn, "_lc_cs", None)
+    if cs is None and not hasattr(fn, "lower") and not hasattr(fn, "as_text"):
+        from thunder_tpu.api import jit as _tt_jit
+
+        fn = _tt_jit(fn)
+        cs = fn._lc_cs
+    if cs is not None:
+        entry = cs.cache_entries[-1] if cs.cache_entries else None
+        report = getattr(entry, "hlo_audit", None) if entry is not None else None
+        if report is None:
+            fn(*args, **kwargs)
+            entry = cs.cache_entries[-1]
+            report = getattr(entry, "hlo_audit", None)
+        if report is None:
+            # Compile-time audit disabled (THUNDER_TPU_HLO_AUDIT=0) or it
+            # degraded to a sharp_edge — audit on demand from the captured
+            # first-run avals.
+            avals = getattr(entry, "hlo_audit_avals", None)
+            if avals and hasattr(entry.computation_fn, "lower"):
+                report = audit_jitted(entry.computation_fn, *avals, device=device)
+        if report is None:
+            raise RuntimeError(
+                "no HLO audit available for this compiled function (the "
+                "compile-time audit failed and no input avals were captured); "
+                "see the sharp_edge events for the failure"
+            )
+    else:
+        report = audit_jitted(fn, *args, device=device, **kwargs)
+    if verbose:
+        print(report.format())
+        for d in report.diagnostics():
+            print(d.format())
+    return report
+
+
 def format_cache_report(jfn: Callable) -> str:
     """Human-readable cache summary for a compiled function: aggregate and
     per-entry hit/miss/recompile counters plus trace/first-run seconds —

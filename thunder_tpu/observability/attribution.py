@@ -540,16 +540,18 @@ def attribute(
     return attr
 
 
-_HLO_META_RE = re.compile(r"%([\w.\-]+)\s*=.*?op_name=\"([^\"]+)\"")
-
-
 def hlo_scope_map(hlo_text: str) -> dict[str, str]:
     """``hlo_op name → metadata op_name`` from compiled HLO text — the join
     table for backends whose trace events carry raw HLO op names instead of
-    scope paths. Only entries whose op_name contains a scope are kept."""
+    scope paths. Only entries whose op_name contains a scope are kept.
+
+    The lexing lives in ``analysis/hlo_audit.iter_op_metadata`` — the HLO
+    auditor's shared tokenizer, so this reader and the static auditor parse
+    the same grammar and cannot drift (one tokenizer, two consumers)."""
+    from thunder_tpu.analysis.hlo_audit import iter_op_metadata
+
     out: dict[str, str] = {}
-    for m in _HLO_META_RE.finditer(hlo_text):
-        op, op_name = m.group(1), m.group(2)
+    for op, op_name in iter_op_metadata(hlo_text):
         if parse_scope(op_name) is not None:
             out[op] = op_name
     return out
