@@ -171,17 +171,20 @@ def _replay(paths: list, storm_threshold: int) -> int:
     return 1 if n_errors else 0
 
 
-def _bench_history_gate(glob_pat: str = "BENCH_r*.json") -> int:
+def _bench_history_gate(glob_pat: str = "BENCH_r*.json",
+                        min_rounds: int = 2) -> int:
     """Run the bench regression gate over one committed bench series
     (``BENCH_r*.json`` single-host, ``MULTICHIP_BENCH_r*.json`` multichip —
-    scripts/perf_report.py). Returns the number of errors (0 when fewer than
-    two committed rounds exist)."""
+    scripts/perf_report.py). Returns the number of errors (0 when fewer
+    than ``min_rounds`` committed rounds exist; the SOAK_POD series passes
+    ``min_rounds=1`` because its absolute federation invariants gate from
+    the first committed round)."""
     import glob
 
     scripts_dir = os.path.dirname(os.path.abspath(__file__))
     repo_root = os.path.dirname(scripts_dir)
     paths = sorted(glob.glob(os.path.join(repo_root, glob_pat)))
-    if len(paths) < 2:
+    if len(paths) < min_rounds:
         return 0
     if scripts_dir not in sys.path:
         sys.path.insert(0, scripts_dir)
@@ -1267,6 +1270,121 @@ def _soak_smoke() -> int:
     return n_errors
 
 
+# The committed SOAK_POD schema (scripts/soak_pod.py — ISSUE 18): the
+# federation invariants the smoke and the committed-round gate both read.
+_POD_REQUIRED_KEYS = (
+    "metric", "value", "unit", "n_devices", "n_slices", "mesh", "model",
+    "steps", "soak_pod_goodput_tokens_per_sec", "soak_pod_wall_s",
+    "soak_pod_degraded_steps", "soak_pod_degraded_tokens_per_sec",
+    "soak_pod_full_width", "soak_pod_final_width", "soak_pod_min_width",
+    "soak_pod_shrinks", "soak_pod_regrows", "soak_pod_restarts",
+    "soak_pod_slice_loss_restores", "soak_pod_slice_loss_nonpeer_restores",
+    "soak_pod_disk_restores_after_anchor", "soak_pod_restore_tiers",
+    "soak_pod_decisions", "soak_pod_unrecovered", "soak_pod_unactuated",
+    "soak_pod_replay_errors",
+)
+
+
+def _federation_smoke() -> int:
+    """--federation: the slice-failure-domain smoke (ISSUE 18 satellite).
+    Runs ``scripts/soak_pod.py --smoke`` — 2 emulated slices × 2 devices,
+    one scripted whole-slice loss — and asserts the elastic cycle
+    completed inside the CI budget: the fleet shrank (one shrink_dp,
+    degraded steps at reduced width), trained through the loss, regrew to
+    full DP width (one regrow_dp, final == full), the victim's state came
+    back from the cross-slice buddy's PEER-RAM tier with disk untouched
+    past the step-0 anchor, and the replayed ledger correlates clean (zero
+    unrecovered / unactuated / replay errors, no process restart). Full
+    runs additionally gate the committed ``SOAK_POD_r*.json`` round's
+    absolute invariants via ``perf_report --gate``. Returns the error
+    count."""
+    import json
+    import subprocess
+    import tempfile
+    import time
+
+    scripts_dir = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(tempfile.mkdtemp(prefix="ttpu_fed_smoke_"),
+                            "pod.json")
+    cmd = [sys.executable, os.path.join(scripts_dir, "soak_pod.py"),
+           "--smoke", "--seed", "7", "--out", out_path]
+    print("--- federation smoke: " + " ".join(cmd))
+    n_errors = 0
+    t0 = time.perf_counter()
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    elapsed = time.perf_counter() - t0
+    for line in r.stderr.strip().splitlines()[-12:]:
+        print(f"    {line}")
+    if r.returncode != 0:
+        print(f"    FAILED: soak_pod exited {r.returncode}")
+        return 1
+    with open(out_path) as f:
+        result = json.load(f)
+
+    missing = [k for k in _POD_REQUIRED_KEYS if k not in result]
+    if missing:
+        n_errors += 1
+        print(f"    FAILED: pod JSON missing keys: {missing}")
+    else:
+        print(f"    schema OK ({len(_POD_REQUIRED_KEYS)} required keys)")
+
+    # The acceptance wall: shrink -> degraded training -> regrow, on CPU,
+    # inside a minute (compiles for both widths included).
+    if elapsed >= 60.0:
+        n_errors += 1
+        print(f"    FAILED: smoke took {elapsed:.1f}s (budget 60s)")
+    else:
+        print(f"    budget OK: shrink->train->regrow in {elapsed:.1f}s")
+
+    full = result.get("soak_pod_full_width")
+    if not (result.get("soak_pod_shrinks") == 1
+            and result.get("soak_pod_regrows") == 1
+            and result.get("soak_pod_degraded_steps", 0) > 0
+            and result.get("soak_pod_min_width", full) < full
+            and result.get("soak_pod_final_width") == full
+            and not result.get("soak_pod_restarts")):
+        n_errors += 1
+        print(f"    FAILED: elastic cycle (shrinks="
+              f"{result.get('soak_pod_shrinks')} regrows="
+              f"{result.get('soak_pod_regrows')} degraded="
+              f"{result.get('soak_pod_degraded_steps')} widths "
+              f"{result.get('soak_pod_min_width')}->"
+              f"{result.get('soak_pod_final_width')}/{full})")
+    else:
+        print(f"    elastic cycle OK: width {full}->"
+              f"{result.get('soak_pod_min_width')}->{full}, "
+              f"{result.get('soak_pod_degraded_steps')} degraded step(s)")
+
+    if (not result.get("soak_pod_slice_loss_restores")
+            or result.get("soak_pod_slice_loss_nonpeer_restores")
+            or result.get("soak_pod_disk_restores_after_anchor")):
+        n_errors += 1
+        print(f"    FAILED: peer-tier proof (restores="
+              f"{result.get('soak_pod_slice_loss_restores')} nonpeer="
+              f"{result.get('soak_pod_slice_loss_nonpeer_restores')} "
+              f"disk_after_anchor="
+              f"{result.get('soak_pod_disk_restores_after_anchor')})")
+    else:
+        print(f"    peer-tier proof OK: tiers "
+              f"{result.get('soak_pod_restore_tiers')}")
+
+    if (result.get("soak_pod_unrecovered")
+            or result.get("soak_pod_unactuated")
+            or result.get("soak_pod_replay_errors")):
+        n_errors += 1
+        print(f"    FAILED: replay (unrecovered="
+              f"{result.get('soak_pod_unrecovered')} unactuated="
+              f"{result.get('soak_pod_unactuated')} errors="
+              f"{result.get('soak_pod_replay_errors')})")
+    else:
+        print("    correlation OK: zero unrecovered faults, zero "
+              "unactuated decisions")
+
+    n_errors += _bench_history_gate("SOAK_POD_r*.json", min_rounds=1)
+    print(f"\nlint_traces --federation: {n_errors} error(s)")
+    return n_errors
+
+
 def _ops_smoke() -> int:
     """--ops: live ops-plane smoke (ISSUE 15; docs/observability.md "ops
     plane"). Starts the per-host HTTP server against a chaos'd GPT step and
@@ -1641,7 +1759,7 @@ def _chaos_multihost_inner() -> int:
 
 
 _USAGE = ("usage: lint_traces.py [pattern] | --static | --schedule | --chaos | "
-          "--chaos-multihost | --multichip | --soak | --hlo | "
+          "--chaos-multihost | --multichip | --soak | --federation | --hlo | "
           "--events <log.jsonl> [...] [--storm-threshold N]")
 
 
@@ -1669,6 +1787,9 @@ def main(argv=None) -> int:
 
     if "--soak" in argv:
         return 1 if _soak_smoke() else 0
+
+    if "--federation" in argv:
+        return 1 if _federation_smoke() else 0
 
     if "--ops" in argv:
         return 1 if _ops_smoke() else 0
@@ -1743,6 +1864,7 @@ def main(argv=None) -> int:
         n_errors += _bench_history_gate()
         n_errors += _bench_history_gate("MULTICHIP_BENCH_r*.json")
         n_errors += _bench_history_gate("SOAK_r*.json")
+        n_errors += _bench_history_gate("SOAK_POD_r*.json", min_rounds=1)
 
     print(f"\nlint_traces: {n_errors} error(s), {n_warnings} warning(s)")
     return 1 if n_errors else 0

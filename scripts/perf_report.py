@@ -124,6 +124,18 @@ _SOAK_NOISE_FLOORS = (
     ("_s", 60.0),                  # any other second-scale soak timing
 )
 
+# SOAK_POD_r* rounds (headline "soak_pod_goodput", from scripts/soak_pod.py
+# — ISSUE 18): same CPU-mesh jitter story as the fleet soak, plus the
+# degraded-window split whose tokens/s rides on a handful of accum-rescaled
+# steps. Checked BEFORE the generic soak table ("soak_pod" startswith
+# "soak"); anything not listed here falls through to the soak floors.
+_SOAK_POD_NOISE_FLOORS = (
+    ("degraded_tokens_per_sec", 600.0),  # ~15-step window, double jitter
+    ("goodput_ratio", 0.05),
+    ("shrink_latency_s", 0.05),    # sub-second controller latencies: gate
+    ("regrow_to_full_s", 2.0),     # on scale changes, not scheduler noise
+)
+
 
 def metric_direction(name: str, series: str = "") -> Optional[int]:
     """+1 = higher is better, -1 = lower is better, None = not gated.
@@ -159,6 +171,10 @@ def noise_floor(name: str, series: str = "") -> float:
     low = name.lower()
     if series.lower().startswith("multichip"):
         for suffix, floor in _MULTICHIP_NOISE_FLOORS:
+            if low.endswith(suffix):
+                return floor
+    if series.lower().startswith("soak_pod"):
+        for suffix, floor in _SOAK_POD_NOISE_FLOORS:
             if low.endswith(suffix):
                 return floor
     if series.lower().startswith("soak"):
@@ -345,9 +361,21 @@ def run_history_gate(
     regressions."""
     rounds = [load_round(p) for p in sorted(paths)]
     rounds = [(l, m) for l, m in rounds if m]
-    if len(rounds) < 2:
-        print("perf_report --history: need at least two rounds with metrics", file=out)
+    if not rounds:
+        print("perf_report --history: no rounds with metrics", file=out)
         return 0
+    if len(rounds) < 2:
+        # No trajectory to diff — but the newest round's ABSOLUTE
+        # acceptance invariants (ops plane, pod federation) still gate:
+        # the SOAK_POD series ships with a single committed round and its
+        # pass/fail proofs must hold from r01 onward.
+        print("perf_report --history: need at least two rounds with metrics "
+              "to diff; checking absolute invariants only", file=out)
+        failures = _ops_plane_failures(rounds[-1]) + _pod_failures(rounds[-1])
+        if failures:
+            print("\nperf_report: acceptance failed on the newest round: "
+                  + ", ".join(failures), file=out)
+        return 1 if (gate and failures) else 0
     if ack_path is None:
         repo_ack = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "BENCH_ACK.json")
@@ -362,7 +390,7 @@ def run_history_gate(
             f"{os.path.basename(ack_path or 'BENCH_ACK.json')}",
             file=out,
         )
-    ops_failures = _ops_plane_failures(rounds[-1])
+    ops_failures = _ops_plane_failures(rounds[-1]) + _pod_failures(rounds[-1])
     if ops_failures:
         print(
             "\nperf_report: ops-plane acceptance failed on the newest soak "
@@ -393,6 +421,61 @@ def _ops_plane_failures(newest: tuple) -> list[str]:
     if lead is not None and lead <= 0:
         out.append(f"{label}: soak_detection_lead={lead:g} (need > 0: an "
                    f"anomaly must precede the decision citing it)")
+    return out
+
+
+def _pod_failures(newest: tuple) -> list[str]:
+    """Absolute federation checks on the newest SOAK_POD round (ISSUE 18)
+    — the elastic shrink/regrow acceptance invariants, pass/fail
+    regardless of how many rounds exist:
+
+    - zero unrecovered faults, unactuated decisions, replay errors, and
+      process restarts;
+    - the fleet actually shrank (min width < full width, degraded steps
+      ran) AND regrew to full DP width (final == full), with shrink and
+      regrow decision counts equal — a flapping slice may not buy extra
+      shrinks;
+    - every slice-loss recovery restored from the cross-slice buddy's
+      peer-RAM tier (nonpeer count 0) and disk served nothing after the
+      step-0 anchor;
+    - when the schedule carried the flap seam, its cooldown->lost
+      re-failure edge is in the ledger (refailures >= 1); when it carried
+      the slow-slice window, the DCN-tier spread detector raised at least
+      one slice_spread anomaly."""
+    label, m = newest
+    if not str(m.get("_metric_name", "")).startswith("soak_pod"):
+        return []
+    out = []
+    for key in ("soak_pod_unrecovered", "soak_pod_unactuated",
+                "soak_pod_replay_errors", "soak_pod_restarts",
+                "soak_pod_slice_loss_nonpeer_restores",
+                "soak_pod_disk_restores_after_anchor"):
+        v = m.get(key)
+        if v:
+            out.append(f"{label}: {key}={v:g}")
+    full, final = m.get("soak_pod_full_width"), m.get("soak_pod_final_width")
+    if full is not None and final != full:
+        out.append(f"{label}: final_width={final:g} != full_width={full:g} "
+                   f"(fleet did not regrow)")
+    if full is not None and not (m.get("soak_pod_min_width", full) < full
+                                 and m.get("soak_pod_degraded_steps", 0) > 0):
+        out.append(f"{label}: no degraded window (the soak never actually "
+                   f"lost a slice)")
+    shrinks, regrows = m.get("soak_pod_shrinks"), m.get("soak_pod_regrows")
+    if shrinks is not None and not (shrinks == regrows and shrinks > 0):
+        out.append(f"{label}: shrinks={shrinks:g} regrows={regrows:g} "
+                   f"(need equal and > 0)")
+    if not m.get("soak_pod_slice_loss_restores"):
+        out.append(f"{label}: soak_pod_slice_loss_restores=0 (no peer-tier "
+                   f"recovery was proven)")
+    if m.get("soak_pod_flap_injected") and \
+            not m.get("soak_pod_flap_refailures"):
+        out.append(f"{label}: flap injected but no cooldown->lost re-failure "
+                   f"edge in the ledger")
+    if m.get("soak_pod_slow_injected") and \
+            not m.get("soak_pod_slice_spread_anomalies"):
+        out.append(f"{label}: slow slice injected but no slice_spread "
+                   f"anomaly was raised")
     return out
 
 

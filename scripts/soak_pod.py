@@ -1,0 +1,591 @@
+#!/usr/bin/env python
+"""Pod soak: federated slice-failure abuse with a committed goodput number.
+
+The falsifiable half of ISSUE 18: run the DP-federated GPT workload on the
+virtual mesh as ``--slices`` emulated ICI slices over a DCN tier, and
+script the four slice seams through one run — a whole-slice loss, a DCN
+partition, a slow slice, a flapping slice — with the fleet controller
+(``resilience/federation.py``) deciding every shrink/regrow through the
+autopilot. The run must end back at FULL width with zero unrecovered
+faults, zero unactuated decisions, and NO process restart; its headline is
+the same goodput shape as the fleet soak::
+
+    goodput = (useful_tokens / wall_s) x (1 - resilience_overhead_pct/100)
+
+with the degraded-mode window accounted honestly: while shrunk, the
+survivors pay the loss-equivalent gradient-accumulation rescale
+(``ceil(accum x W / w)`` micro-steps per optimizer step), so the measured
+degraded tokens/s really is lower — reduced throughput, unchanged global
+batch.
+
+Acceptance invariants proven from the replayed event ledger (and gated by
+``scripts/perf_report.py --history SOAK_POD_r*.json --gate``):
+
+- every slice-loss recovery restored from the cross-slice buddy's PEER-RAM
+  tier (``restore`` events ``tier="peer"``) — disk is touched only by the
+  step-0 durability anchor;
+- the flapping slice cost exactly one ``shrink_dp`` and one deferred
+  ``regrow_dp`` (its cooldown->lost re-failure edge is in the ledger, and
+  the decision count did not grow);
+- the fleet regrew to full DP width without a process restart;
+- the slow slice raised a ``slice_spread`` anomaly (the DCN-tier spread
+  detector) that fed the autopilot's strike ledger.
+
+Output: one JSON line (the committed ``SOAK_POD_r*.json`` series).
+``scripts/lint_traces.py --federation`` runs the ``--smoke`` shape in CI.
+
+Usage::
+
+    python scripts/soak_pod.py                            # 60 steps, seed 1
+    python scripts/soak_pod.py --steps 60 --seed 1 --out SOAK_POD_r01.json
+    python scripts/soak_pod.py --smoke                    # CI-sized run
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _log(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr)
+
+
+# =============================================================================
+# The scripted slice-seam schedule
+# =============================================================================
+
+
+def make_spec(args) -> str:
+    """The chaos spec for one pod soak — exact-step slice seams, so the
+    episode structure (loss -> regrow -> partition -> slow window -> flap)
+    is deterministic per seed and the gate can count episodes exactly.
+
+    Full shape (``--steps`` >= 40): a whole-slice loss in the first third,
+    a DCN partition at the midpoint (healing after ``heal`` steps while
+    training continues in-slice), a count-limited slow window on slice 0
+    (always active — the spread detector must flag it), and a flap at the
+    two-thirds mark. Smoke shape: the slice loss alone — one scripted
+    loss, shrink -> degraded training -> regrow, CI-sized."""
+    loss_at = max(3, args.steps // 4)
+    if args.smoke:
+        return f"slice_loss@{loss_at},slice=1;seed={args.seed}"
+    part_at = max(loss_at + args.recover_after + 6, args.steps // 2)
+    flap_at = max(part_at + 6, (2 * args.steps) // 3)
+    heal = 4
+    slow_n = 12
+    return (
+        f"slice_loss@{loss_at},slice=1"
+        f";dcn_partition@{part_at}~{heal}"
+        f";slice_slow@slice=0~{args.slow_delay_s}*{slow_n}"
+        f";slice_flap@{flap_at},slice=1"
+        f";seed={args.seed}"
+    )
+
+
+def _measure_pod_overheads(step_fn, state, *, snapshot_every: int, n: int = 6):
+    """(ideal step seconds, resilience_overhead_pct) for the FEDERATED
+    driver: its steady-state resilience tax is the cross-slice snapshot
+    pipeline (host copy + checksum + buddy replication every
+    ``snapshot_every`` steps), not the fleet soak's SDC guard — the pod
+    driver runs no guard, and recovery/rebuild time is already inside the
+    soak wall clock. Measured directly (median-vs-median, same reasoning
+    as ``soak_fleet._measure_overheads``: loop deltas drown in CPU-mesh
+    jitter) against a scratch 2-store ring so the real ring stays clean."""
+    from thunder_tpu.resilience.snapshot import (
+        Snapshot, SnapshotStore, pytree_crc32, to_host)
+
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    steps = []
+    for _ in range(max(4, n)):
+        t0 = time.perf_counter()
+        state, _ = step_fn(state)
+        steps.append(time.perf_counter() - t0)
+    scratch = [SnapshotStore(host=i, ring=2) for i in range(2)]
+    SnapshotStore.make_ring(scratch)
+    snaps = []
+    for i in range(4):
+        t0 = time.perf_counter()
+        host_state = to_host(state)
+        scratch[0].put(Snapshot(step=i, state=host_state,
+                                crcs=pytree_crc32(host_state)))
+        snaps.append(time.perf_counter() - t0)
+    step_s, snap_s = med(steps), med(snaps)
+    per_step = snap_s / max(1, snapshot_every)
+    overhead_pct = (per_step / step_s * 100.0) if step_s else 0.0
+    return step_s, overhead_pct, state
+
+
+# =============================================================================
+# The pod run
+# =============================================================================
+
+
+def run_pod(args) -> dict:
+    import numpy as np
+
+    import thunder_tpu.monitor as monitor
+    from thunder_tpu.analysis import Severity
+    from thunder_tpu.analysis.events import format_replay, replay_events
+    from thunder_tpu.core import dtypes
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.parallel import build_train_step, make_mesh
+    from thunder_tpu.parallel.sharding import gpt_param_specs
+    from thunder_tpu.parallel.train import opt_state_specs
+    from thunder_tpu.resilience import chaos
+    from thunder_tpu.resilience import federation as fed
+    from thunder_tpu.resilience.autopilot import Autopilot
+    from thunder_tpu.resilience.elastic import mesh_shape
+    from thunder_tpu.resilience.preemption import CheckpointManager
+    from thunder_tpu.resilience.snapshot import SnapshotStore
+
+    import tempfile
+
+    tmp = args.workdir or tempfile.mkdtemp(prefix="ttpu_pod_")
+    log = os.path.join(tmp, "events.jsonl")
+    monitor.set_event_log(log)
+
+    plane = None
+    if args.ops_plane:
+        from thunder_tpu.observability import opsplane
+        from thunder_tpu.observability.detect import DetectorConfig
+
+        plane = opsplane.enable(
+            port=0, serve=True,
+            flightrec_dir=os.path.join(tmp, "flightrec"),
+            detectors=DetectorConfig(
+                min_samples=4, cooldown=8,
+                spread_min_steps=3, spread_consecutive=2,
+            ),
+        )
+        _log(f"ops plane: http://127.0.0.1:{plane.port} "
+             f"(/metrics /healthz /debug/state)")
+
+    # ---- the federated workload -------------------------------------------
+    devices_per_slice = args.devices // args.slices
+    cfg = m.name_to_config(args.model)
+    params = m.init_params(cfg, dtype=dtypes.float32, seed=0)
+    rng = np.random.RandomState(args.seed)
+    idx = rng.randint(0, cfg.vocab_size,
+                      (args.batch, args.seq)).astype(np.int32)
+    tgt = np.roll(idx, -1, axis=1).astype(np.int32)
+
+    def mesh_for_width(w):
+        # Width w slices == a dp=w group of fsdp blocks: each emulated
+        # slice owns one contiguous fsdp block of devices, and losing a
+        # slice shrinks dp — the exact shrink the real federation performs.
+        mesh = make_mesh(dp=w, fsdp=devices_per_slice)
+        p_specs = gpt_param_specs(cfg, mesh)
+        return mesh, (p_specs, opt_state_specs(p_specs))
+
+    step_cache: dict = {}
+
+    def base_step_for(mesh):
+        key = tuple(sorted((mesh_shape(mesh) or {}).items()))
+        if key in step_cache:
+            return step_cache[key]
+        specs = gpt_param_specs(cfg, mesh)
+        step, _ = build_train_step(
+            cfg, params, idx, tgt, mesh=mesh, param_specs=specs, lr=1e-2,
+            executors=["jax"], donate=False,
+        )
+
+        def step_fn(state):
+            p, o = state
+            p, o, loss = step(p, o, idx, tgt)
+            return (p, o), float(np.asarray(loss))
+
+        step_cache[key] = step_fn
+        return step_fn
+
+    accum_seen: list = []
+
+    def build_for_width(mesh, width, accum):
+        base = base_step_for(mesh)
+        accum_seen.append(accum)
+        if accum <= 1:
+            return base
+
+        # The loss-equivalent rescale made physical: the survivors run
+        # `accum` micro-steps per driver step, so the degraded window's
+        # measured tokens/s honestly drops with the width.
+        def step_fn(state):
+            loss = float("nan")
+            for _ in range(accum):
+                state, loss = base(state)
+            return state, loss
+
+        return step_fn
+
+    full_mesh, _ = mesh_for_width(args.slices)
+    specs0 = gpt_param_specs(cfg, full_mesh)
+    _, opt0 = build_train_step(
+        cfg, params, idx, tgt, mesh=full_mesh, param_specs=specs0, lr=1e-2,
+        executors=["jax"], donate=False,
+    )
+    state0 = (params, opt0)
+    tokens_per_step = args.batch * args.seq
+    _log(f"workload: {args.model} B={args.batch} T={args.seq} "
+         f"slices={args.slices} mesh={mesh_shape(full_mesh)}")
+
+    # Warm the full-width step, then price the ideal step + resilience
+    # overhead OUTSIDE the soak wall clock.
+    full_step = base_step_for(full_mesh)
+    state, _ = full_step(state0)
+    ideal_step_s, overhead_pct, _ = _measure_pod_overheads(
+        full_step, state, snapshot_every=args.snapshot_every)
+    ideal_tps = tokens_per_step / ideal_step_s if ideal_step_s else 0.0
+    _log(f"ideal step {ideal_step_s * 1e3:.1f}ms -> {ideal_tps:.0f} tok/s; "
+         f"resilience overhead {overhead_pct:.2f}%")
+
+    # ---- the controller + cross-slice snapshot ring -----------------------
+    ledger = fed.FederationLedger(args.slices)
+    autopilot = Autopilot()
+    controller = fed.FleetController(
+        ledger, autopilot,
+        rejoin_backoff_s=args.rejoin_backoff_s,
+        hysteresis_s=args.rejoin_backoff_s,
+    )
+    stores = [SnapshotStore(host=i, ring=args.snapshot_ring)
+              for i in range(args.slices)]
+    SnapshotStore.make_ring(stores)
+    mgr = CheckpointManager(os.path.join(tmp, "ckpt"), keep=3,
+                            backoff_s=0.01, store=stores[0])
+
+    spec = make_spec(args)
+    _log(f"schedule (seed={args.seed}): {spec}")
+
+    # Per-width wall-time buckets for the honest degraded-goodput split.
+    t_last = [time.perf_counter()]
+    width_wall: dict = {}
+    width_steps: dict = {}
+    min_width = [args.slices]
+
+    def on_step(step, loss, width):
+        now = time.perf_counter()
+        width_wall[width] = width_wall.get(width, 0.0) + (now - t_last[0])
+        width_steps[width] = width_steps.get(width, 0) + 1
+        t_last[0] = now
+        min_width[0] = min(min_width[0], width)
+
+    slice_feed = plane.bank.note_slice_step if (
+        plane is not None and plane.bank is not None) else None
+
+    wall0 = time.perf_counter()
+    t_last[0] = wall0
+    halted = None
+    with chaos.chaos_scope(spec):
+        try:
+            state, report = fed.run_federated_training(
+                controller, build_for_width, state0, args.steps,
+                manager=mgr, mesh_for_width=mesh_for_width, stores=stores,
+                snapshot_every=args.snapshot_every,
+                recover_after=args.recover_after, on_step=on_step,
+                slice_step_time=slice_feed,
+            )
+        except fed.AutopilotHalt as e:
+            halted = str(e)
+            report = getattr(e, "report", None) or fed.FleetReport(
+                losses=[], full_width=args.slices, final_width=0)
+    wall_s = time.perf_counter() - wall0
+    mgr.close()
+
+    ops_healthz = None
+    ops_federation = None
+    ops_port = plane.port if plane is not None else None
+    if plane is not None:
+        try:
+            import urllib.error
+            import urllib.request
+
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{plane.port}/healthz",
+                        timeout=5) as r:
+                    body = r.read().decode()
+            except urllib.error.HTTPError as e:
+                body = e.read().decode()
+            ops_healthz = json.loads(body).get("status")
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{plane.port}/debug/state",
+                    timeout=5) as r:
+                dbg = json.loads(r.read().decode())
+            fed_dbg = dbg.get("federation") or {}
+            ops_federation = {"width": fed_dbg.get("width"),
+                              "n_slices": fed_dbg.get("n_slices")}
+        except Exception as e:
+            ops_healthz = f"unreachable: {e}"
+    fed.install_ledger(None)
+
+    monitor.set_event_log(None)
+    summary, diags = replay_events(log, storm_threshold=64)
+    errors = [d for d in diags if d.severity >= Severity.ERROR]
+    for line in format_replay(summary, diags).splitlines():
+        _log(line)
+
+    # ---- ledger-derived invariants ----------------------------------------
+    recs = []
+    with open(log) as f:
+        for line in f:
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                continue
+    restores = [r for r in recs if r.get("kind") == "restore" and r.get("ok")]
+    # Each slice-loss episode's recovery restore: the first ok restore
+    # after the fault_injected record. Must be the buddy's peer-RAM tier.
+    loss_tiers = []
+    shrink_latencies = []
+    for i, r in enumerate(recs):
+        if r.get("kind") == "fault_injected" and r.get("seam") in (
+                "slice_loss", "slice_flap"):
+            nxt = next((q for q in recs[i + 1:]
+                        if q.get("kind") == "restore" and q.get("ok")), None)
+            if nxt is not None:
+                loss_tiers.append(nxt["tier"])
+                shrink_latencies.append(float(nxt["ts"]) - float(r["ts"]))
+    disk_after_anchor = sum(
+        1 for r in restores[1:] if r.get("tier") == "disk")
+    flap_refailures = sum(
+        1 for r in recs if r.get("kind") == "slice_state"
+        and r.get("from") == "cooldown" and r.get("to") == "lost")
+    # Regrow-to-full-width latency per episode: lost slice_state ->
+    # the regrow decision's elastic_resume back at full width.
+    regrow_s = 0.0
+    lost_ts = None
+    for r in recs:
+        if (r.get("kind") == "slice_state" and r.get("to") == "lost"
+                and lost_ts is None):
+            lost_ts = float(r["ts"])
+        if (r.get("kind") == "autopilot_decision"
+                and r.get("actuator") == "regrow_dp" and lost_ts is not None):
+            regrow_s = max(regrow_s, float(r["ts"]) - lost_ts)
+            lost_ts = None
+    anomalies = dict(summary.get("anomalies") or {})
+
+    if plane is not None:
+        from thunder_tpu.observability import opsplane
+
+        opsplane.disable()
+
+    useful_tokens = args.steps * tokens_per_step
+    tps = useful_tokens / wall_s if wall_s else 0.0
+    goodput = tps * (1.0 - overhead_pct / 100.0)
+    ratio = goodput / ideal_tps if ideal_tps else 0.0
+    degraded_wall = sum(s for w, s in width_wall.items() if w < args.slices)
+    degraded_steps = sum(n for w, n in width_steps.items() if w < args.slices)
+    degraded_tps = (degraded_steps * tokens_per_step / degraded_wall
+                    if degraded_wall else 0.0)
+
+    result = {
+        "metric": "soak_pod_goodput",
+        "value": round(goodput, 1),
+        "unit": "tokens/s",
+        "seed": args.seed,
+        "n_devices": args.devices,
+        "n_slices": args.slices,
+        "mesh": mesh_shape(full_mesh),
+        "model": args.model,
+        "batch": args.batch,
+        "seq": args.seq,
+        "steps": args.steps,
+        "soak_pod_goodput_tokens_per_sec": round(goodput, 1),
+        "soak_pod_tokens_per_sec": round(tps, 1),
+        "soak_pod_ideal_tokens_per_sec": round(ideal_tps, 1),
+        "soak_pod_goodput_ratio": round(ratio, 4),
+        "resilience_overhead_pct": round(overhead_pct, 2),
+        "soak_pod_wall_s": round(wall_s, 2),
+        # Degraded-mode honesty: tokens/s measured INSIDE the reduced-width
+        # window, with the accum-rescale micro-steps charged to it.
+        "soak_pod_degraded_steps": degraded_steps,
+        "soak_pod_degraded_tokens_per_sec": round(degraded_tps, 1),
+        "soak_pod_grad_accum_max": max(accum_seen) if accum_seen else 1,
+        "soak_pod_partitioned_steps": report.partitioned_steps,
+        # Fleet trajectory: shrank, trained degraded, regrew to full width,
+        # in ONE process.
+        "soak_pod_full_width": report.full_width,
+        "soak_pod_final_width": report.final_width,
+        "soak_pod_min_width": min_width[0],
+        "soak_pod_shrinks": report.shrinks,
+        "soak_pod_regrows": report.regrows,
+        "soak_pod_flap_refailures": flap_refailures,
+        # Which optional seams this run's schedule carried, so the perf
+        # gate knows which absolute invariants apply (smoke runs inject
+        # only the slice loss).
+        "soak_pod_flap_injected": int(not args.smoke),
+        "soak_pod_slow_injected": int(not args.smoke),
+        "soak_pod_restarts": 0 if halted is None else 1,
+        "soak_pod_halted": halted,
+        "soak_pod_steps_executed": report.steps_executed,
+        "soak_pod_final_loss": next(
+            (v for v in reversed(report.losses) if v is not None), None),
+        # The tier proof: every slice-loss recovery read the cross-slice
+        # buddy's RAM; disk served only the step-0 anchor.
+        "soak_pod_slice_loss_restores": len(loss_tiers),
+        "soak_pod_slice_loss_restore_tiers": loss_tiers,
+        # Numeric form of the tier proof for the perf gate (which keeps
+        # only numeric fields): restores that did NOT come from peer RAM.
+        "soak_pod_slice_loss_nonpeer_restores": sum(
+            1 for t in loss_tiers if t != "peer"),
+        "soak_pod_disk_restores_after_anchor": disk_after_anchor,
+        "soak_pod_restore_tiers": summary.get("restore_tiers") or {},
+        "soak_pod_shrink_latency_s": round(max(shrink_latencies), 3)
+        if shrink_latencies else 0.0,
+        "soak_pod_regrow_to_full_s": round(regrow_s, 3),
+        "soak_pod_faults_injected": len(summary.get("faults_injected") or []),
+        "soak_pod_decisions": summary.get("autopilot_decisions") or {},
+        "soak_pod_unrecovered": len(summary.get("unrecovered_faults") or []),
+        "soak_pod_unactuated": len(summary.get("unactuated_decisions") or []),
+        "soak_pod_replay_errors": len(errors),
+        # Ops plane: the DCN-tier spread detector's verdicts + the
+        # federation rollup served over HTTP during the run.
+        "soak_pod_anomalies": anomalies,
+        "soak_pod_slice_spread_anomalies": int(
+            anomalies.get("slice_spread") or 0),
+        "soak_pod_ops_port": ops_port,
+        "soak_pod_ops_healthz": ops_healthz,
+        "soak_pod_ops_federation": ops_federation,
+        "events_log": log,
+    }
+    _log(f"goodput {goodput:.0f} tok/s ({ratio * 100:.1f}% of ideal "
+         f"{ideal_tps:.0f}) over {wall_s:.1f}s wall; degraded window "
+         f"{degraded_steps} step(s) at {degraded_tps:.0f} tok/s; "
+         f"{report.shrinks} shrink(s), {report.regrows} regrow(s), "
+         f"{flap_refailures} flap re-failure(s), "
+         f"unrecovered={result['soak_pod_unrecovered']}, "
+         f"unactuated={result['soak_pod_unactuated']}")
+    _log(f"tiers: slice-loss restores {loss_tiers or 'none'}, "
+         f"{disk_after_anchor} disk restore(s) after the anchor; "
+         f"slice_spread anomalies {result['soak_pod_slice_spread_anomalies']}")
+    return result
+
+
+# =============================================================================
+# Driver
+# =============================================================================
+
+
+def pod_ok(result: dict) -> bool:
+    """The pod soak's pass condition (the ISSUE 18 acceptance gate)."""
+    loss = result.get("soak_pod_final_loss")
+    ok = (
+        result.get("soak_pod_unrecovered") == 0
+        and result.get("soak_pod_unactuated") == 0
+        and result.get("soak_pod_replay_errors") == 0
+        and result.get("soak_pod_restarts") == 0
+        and loss is not None and loss == loss  # not NaN
+        # Training continued through the loss and regrew to full DP width.
+        and result.get("soak_pod_degraded_steps", 0) > 0
+        and result.get("soak_pod_min_width", 0)
+        < result.get("soak_pod_full_width", 0)
+        and result.get("soak_pod_final_width")
+        == result.get("soak_pod_full_width")
+        and result.get("soak_pod_shrinks", 0)
+        == result.get("soak_pod_regrows", -1) > 0
+        # Every slice-loss recovery came from the buddy's peer RAM.
+        and result.get("soak_pod_slice_loss_restores", 0) > 0
+        and all(t == "peer"
+                for t in result.get("soak_pod_slice_loss_restore_tiers", ()))
+        and result.get("soak_pod_disk_restores_after_anchor") == 0
+    )
+    if ok and result.get("soak_pod_flap_refailures", 0) > 0:
+        # The flap episode must not have bought extra shrinks: episodes
+        # (loss + flap) == 2 decisions each way, never 3.
+        ok = result.get("soak_pod_shrinks") == result.get("soak_pod_regrows")
+    if ok and result.get("soak_pod_ops_port") is not None \
+            and result.get("soak_pod_anomalies", {}).get("slice_spread") is not None:
+        ok = result.get("soak_pod_ops_healthz") not in (None, "")
+    return ok
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="soak_pod.py",
+        description="Slice-failure soak on the federated virtual mesh "
+                    "(SOAK_POD series)",
+    )
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--slices", type=int, default=2)
+    p.add_argument("--model", default="gpt-tiny")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=32)
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--snapshot-every", type=int, default=2)
+    p.add_argument("--snapshot-ring", type=int, default=4)
+    p.add_argument("--recover-after", type=int, default=6,
+                   help="steps after a slice_loss before the victim "
+                        "reports healthy (the scheduler re-grant stand-in)")
+    p.add_argument("--rejoin-backoff-s", type=float, default=0.05,
+                   help="controller rejoin backoff == hysteresis window, "
+                        "sized to the CPU mesh's compressed timescale")
+    p.add_argument("--slow-delay-s", type=float, default=0.05,
+                   help="per-step inflation of the slice_slow window")
+    p.add_argument("--ops-plane", action=argparse.BooleanOptionalAction,
+                   default=True)
+    p.add_argument("--smoke", action="store_true",
+                   help="CI-sized run: 2 slices x 2 devices, 16 steps, one "
+                        "scripted slice loss (lint_traces --federation)")
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--out", default=None, help="also write the JSON here")
+    p.add_argument("--_subprocess", action="store_true",
+                   help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.devices, args.steps = 4, 16
+        args.recover_after = 4
+    if args.devices % args.slices:
+        p.error("--devices must divide evenly into --slices")
+
+    import jax
+
+    if len(jax.devices()) < args.devices and not args._subprocess:
+        import subprocess
+
+        env = {
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "HOME": os.environ.get("HOME", "/root"),
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS":
+                f"--xla_force_host_platform_device_count={args.devices}",
+            "THUNDER_TPU_RETRY_BACKOFF_S": "0",
+        }
+        cmd = [sys.executable, os.path.abspath(__file__), "--_subprocess"] + [
+            a for a in (argv if argv is not None else sys.argv[1:])
+        ]
+        r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                           timeout=3000)
+        sys.stderr.write(r.stderr[-8000:] if len(r.stderr) > 8000
+                         else r.stderr)
+        if r.returncode != 0:
+            print(f"soak_pod subprocess failed:\n{r.stdout[-2000:]}",
+                  file=sys.stderr)
+            return r.returncode
+        line = r.stdout.strip().splitlines()[-1]
+        json.loads(line)  # malformed output must fail loudly
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        return 0
+
+    os.environ.setdefault("THUNDER_TPU_RETRY_BACKOFF_S", "0")
+    result = run_pod(args)
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0 if pod_ok(result) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -65,6 +65,12 @@ class DeviceSpec:
     peak_flops: dict[str, float]
     hbm_bw: float
     ici_bw: float = 0.0
+    # Cross-slice (data-center network) wire bandwidth in bytes/s — the
+    # second interconnect class of a federated mesh (ISSUE 18). An order of
+    # magnitude below ICI on every real pod: collectives on the "dcn" mesh
+    # axis (and the cross-slice leg of hier_all_reduce) price at this rate.
+    # 0 means no DCN tier: cross-slice traffic falls back to ici_bw.
+    dcn_bw: float = 0.0
     # Per-chip HBM capacity in bytes (datasheet; the runtime reserves a
     # fraction — analysis/liveness.device_capacity_bytes prefers the live
     # backend's bytes_limit and the THUNDER_TPU_HBM_BYTES override). 0 means
@@ -91,6 +97,12 @@ class DeviceSpec:
                 return float(bw)
         return self.ici_bw
 
+    @property
+    def dcn_bw_or_ici(self) -> float:
+        """The rate DCN-tier wire bytes price at: ``dcn_bw`` when the spec
+        has a DCN class, else ``ici_bw`` (single-interconnect specs)."""
+        return self.dcn_bw or self.ici_bw
+
     def ridge(self, dtype: Any) -> float:
         """Arithmetic intensity (FLOP/byte) at which compute and memory
         time are equal — ops above it are compute-bound."""
@@ -107,21 +119,25 @@ def _dtype_class(dtype: Any) -> str:
 # Datasheet peaks. f32 on TPU runs through the MXU at roughly half bf16
 # throughput (XLA splits f32 matmuls); "cpu" is a deliberately small spec so
 # host-platform tests still classify sensibly.
+# dcn_bw: per-chip share of the data-center network between slices — NIC
+# line rate divided across the host's chips, an order of magnitude (or two)
+# below ICI everywhere. These drive the federated-mesh roofline (ISSUE 18),
+# not any single-slice number.
 DEVICE_SPECS: dict[str, DeviceSpec] = {
     "v5e": DeviceSpec("v5e", {"bf16": 197e12, "f32": 98.5e12, "int8": 394e12},
-                      hbm_bw=819e9, ici_bw=186e9, hbm_bytes=16e9),
+                      hbm_bw=819e9, ici_bw=186e9, dcn_bw=6.25e9, hbm_bytes=16e9),
     "v5p": DeviceSpec("v5p", {"bf16": 459e12, "f32": 229.5e12, "int8": 918e12},
-                      hbm_bw=2765e9, ici_bw=600e9, hbm_bytes=95e9),
+                      hbm_bw=2765e9, ici_bw=600e9, dcn_bw=25e9, hbm_bytes=95e9),
     "v4": DeviceSpec("v4", {"bf16": 275e12, "f32": 137.5e12, "int8": 275e12},
-                     hbm_bw=1228e9, ici_bw=300e9, hbm_bytes=32e9),
+                     hbm_bw=1228e9, ici_bw=300e9, dcn_bw=6.25e9, hbm_bytes=32e9),
     "v6e": DeviceSpec("v6e", {"bf16": 918e12, "f32": 459e12, "int8": 1836e12},
-                      hbm_bw=1640e9, ici_bw=448e9, hbm_bytes=32e9),
+                      hbm_bw=1640e9, ici_bw=448e9, dcn_bw=12.5e9, hbm_bytes=32e9),
     "a100": DeviceSpec("a100", {"bf16": 312e12, "f32": 19.5e12, "int8": 624e12},
-                       hbm_bw=1555e9, ici_bw=600e9, hbm_bytes=80e9),
+                       hbm_bw=1555e9, ici_bw=600e9, dcn_bw=25e9, hbm_bytes=80e9),
     # Host RAM is not a fixed datasheet number; 0 = capacity unknown, so the
     # liveness fit checks defer to memory_stats / THUNDER_TPU_HBM_BYTES.
     "cpu": DeviceSpec("cpu", {"bf16": 2e11, "f32": 2e11, "int8": 4e11},
-                      hbm_bw=5e10, ici_bw=1e10, hbm_bytes=0.0),
+                      hbm_bw=5e10, ici_bw=1e10, dcn_bw=1e9, hbm_bytes=0.0),
 }
 
 
@@ -214,11 +230,14 @@ def resolve_device_spec(device: Any = None) -> DeviceSpec:
 @dataclass
 class OpCost:
     """Static cost of one BoundSymbol. ``bytes_moved`` is HBM traffic
-    (reads + writes); ``comm_bytes`` is interconnect wire traffic."""
+    (reads + writes); ``comm_bytes`` is TOTAL interconnect wire traffic, of
+    which ``dcn_bytes`` crosses the cross-slice DCN tier (ISSUE 18) and
+    prices at :attr:`DeviceSpec.dcn_bw` instead of ICI."""
 
     flops: float = 0.0
     bytes_moved: float = 0.0
     comm_bytes: float = 0.0
+    dcn_bytes: float = 0.0
     kind: str = "other"
 
     @property
@@ -350,12 +369,45 @@ def _sdpa_cost(bsym, *, bwd: bool = False) -> OpCost:
     return OpCost(flops=flops, bytes_moved=_io_bytes(bsym), kind="sdpa")
 
 
+# The mesh axis whose hops cross slice boundaries (parallel/mesh.DCN_AXIS;
+# the literal avoids importing jax-adjacent modules into the cost model).
+_DCN_AXIS = "dcn"
+
+
+def _collective_axis(bsym) -> Optional[str]:
+    """The (first) mesh-axis operand of a collective bsym, when it is a
+    string — the axis-aware bandwidth selection key (ISSUE 18)."""
+    axis = bsym.args[1] if len(bsym.args) > 1 else bsym.kwargs.get("axis")
+    return axis if isinstance(axis, str) else None
+
+
+def _hier_all_reduce_cost(bsym) -> OpCost:
+    """Wire bytes of the hierarchical all-reduce (dist_prims.hier_all_reduce):
+    in-slice reduce-scatter + all-gather move ``2·(g_in−1)/g_in·nbytes``
+    over ICI; the cross-slice all-reduce moves ``2·(g_out−1)/g_out`` of the
+    1/g_in SHARD over DCN — the whole point of the lowering."""
+    nbytes = float(sum(p.size_bytes for p in _tensor_args(bsym)))
+    args = list(bsym.args) + [bsym.kwargs.get(k) for k in ()]
+    g_in = args[3] if len(args) > 3 else bsym.kwargs.get("inner_size", 1)
+    g_out = args[4] if len(args) > 4 else bsym.kwargs.get("outer_size", 1)
+    g_in = int(pyval(g_in) or 1)
+    g_out = int(pyval(g_out) or 1)
+    ici = 2.0 * (g_in - 1) / g_in * nbytes if g_in > 1 else 0.0
+    shard = nbytes / max(1, g_in)
+    dcn = 2.0 * (g_out - 1) / g_out * shard if g_out > 1 else 0.0
+    return OpCost(comm_bytes=ici + dcn, dcn_bytes=dcn, kind="collective")
+
+
 def _collective_cost(bsym) -> OpCost:
     name = bsym.sym.name
+    if name == "hier_all_reduce":
+        return _hier_all_reduce_cost(bsym)
     factor_fn = _COLLECTIVE_FACTORS.get(name)
     nbytes = float(sum(p.size_bytes for p in _tensor_args(bsym)))
+    on_dcn = _collective_axis(bsym) == _DCN_AXIS
     if factor_fn is None:
-        return OpCost(comm_bytes=nbytes, kind="collective")
+        return OpCost(comm_bytes=nbytes, dcn_bytes=nbytes if on_dcn else 0.0,
+                      kind="collective")
     g = 1
     for a in bsym.flat_args:
         v = pyval(a)
@@ -371,8 +423,12 @@ def _collective_cost(bsym) -> OpCost:
         out = bsym.output
         out_bytes = float(getattr(out, "size_bytes", 0.0) or 0.0)
         if out_bytes > nbytes:
-            return OpCost(comm_bytes=(g - 1) / g * out_bytes, kind="collective")
-    return OpCost(comm_bytes=factor_fn(g) * nbytes, kind="collective")
+            wire = (g - 1) / g * out_bytes
+            return OpCost(comm_bytes=wire, dcn_bytes=wire if on_dcn else 0.0,
+                          kind="collective")
+    wire = factor_fn(g) * nbytes
+    return OpCost(comm_bytes=wire, dcn_bytes=wire if on_dcn else 0.0,
+                  kind="collective")
 
 
 def bsym_cost(bsym) -> Optional[OpCost]:
@@ -462,6 +518,9 @@ class TraceCost:
     total_flops: float = 0.0
     total_bytes: float = 0.0
     total_comm_bytes: float = 0.0
+    # DCN-tier portion of total_comm_bytes: bytes a federated mesh moves
+    # across the slice boundary (the "dcn" axis), priced at dcn_bw.
+    total_dcn_bytes: float = 0.0
     # Σ flops/peak at each op's OWN dtype peak (accumulated by trace_cost so
     # the pure-compute bound agrees with the per-row roofline terms — a
     # bf16 trace must not be scored at the f32 peak here).
@@ -484,11 +543,13 @@ class TraceCost:
 
     @property
     def comm_s(self) -> float:
-        """Pure-wire bound: total ring-collective traffic at ICI bandwidth
-        (0 when the trace has no collectives or the spec has no ICI)."""
+        """Pure-wire bound: in-slice traffic at ICI bandwidth plus the
+        DCN-tier portion at the spec's DCN class (0 when the trace has no
+        collectives or the spec has no ICI)."""
         if not self.total_comm_bytes or not self.device.ici_bw:
             return 0.0
-        return self.total_comm_bytes / self.device.ici_bw
+        ici = self.total_comm_bytes - self.total_dcn_bytes
+        return ici / self.device.ici_bw + self.total_dcn_bytes / self.device.dcn_bw_or_ici
 
     def collective_rows(self) -> list[OpCostRow]:
         """The trace's collective ops — the predicted half of the
@@ -520,7 +581,8 @@ class TraceCost:
             f"{dev.hbm_bw / 1e9:.0f} GB/s HBM]",
             f"  total: {self.total_flops / 1e9:.3f} GFLOP, "
             f"{self.total_bytes / 1e6:.2f} MB moved"
-            + (f", {self.total_comm_bytes / 1e6:.2f} MB on ICI" if self.total_comm_bytes else ""),
+            + (f", {(self.total_comm_bytes - self.total_dcn_bytes) / 1e6:.2f} MB on ICI" if self.total_comm_bytes else "")
+            + (f", {self.total_dcn_bytes / 1e6:.2f} MB on DCN" if self.total_dcn_bytes else ""),
             f"  roofline step-time bound: {self.roofline_s * 1e3:.3f} ms unfused "
             f"(compute {self.compute_s * 1e3:.3f} ms, memory {self.memory_s * 1e3:.3f} ms)",
             f"  {'line':>5} {'sym':<28} {'kind':<12} {'GFLOP':>10} {'MB':>9} "
@@ -559,7 +621,13 @@ def trace_cost(trace: TraceCtx, device: Any = None) -> TraceCost:
         t_compute = c.flops / dev.peak_for(dtype)
         t_memory = c.bytes_moved / dev.hbm_bw
         ici_bw = dev.ici_bw_for(collective_sym_class(bsym.sym.name)) if c.comm_bytes else 0.0
-        t_comm = c.comm_bytes / ici_bw if ici_bw and c.comm_bytes else 0.0
+        if ici_bw and c.comm_bytes:
+            # Price the two wire classes separately: in-slice bytes at the
+            # (family-fitted) ICI rate, cross-slice bytes at the DCN rate.
+            t_comm = (c.comm_bytes - c.dcn_bytes) / ici_bw
+            t_comm += c.dcn_bytes / dev.dcn_bw_or_ici
+        else:
+            t_comm = 0.0
         t = max(t_compute, t_memory, t_comm)
         if t == 0.0:
             bound = "free"
@@ -578,6 +646,7 @@ def trace_cost(trace: TraceCtx, device: Any = None) -> TraceCost:
         tc.total_flops += c.flops
         tc.total_bytes += c.bytes_moved
         tc.total_comm_bytes += c.comm_bytes
+        tc.total_dcn_bytes += c.dcn_bytes
         tc._compute_s += t_compute
     return tc
 

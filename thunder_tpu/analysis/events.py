@@ -81,6 +81,13 @@ SCHEMA: dict[str, frozenset] = {
     # is a fault-in-progress capture.
     "anomaly": frozenset({"anomaly", "severity", "value", "baseline"}),
     "flightrec_dump": frozenset({"reason", "records"}),
+    # Slice-granular failure domains (ISSUE 18; docs/robustness.md "failure
+    # domains"): one record per federation-ledger transition (the typed
+    # slice membership state machine in resilience/federation.py), and the
+    # restore-entry sweep of orphan checkpoint tmp dirs left by writers
+    # that died mid-flush.
+    "slice_state": frozenset({"slice", "from", "to", "reason"}),
+    "ckpt_tmp_sweep": frozenset({"count"}),
 }
 _COMMON = frozenset({"v", "ts", "seq", "kind"})
 
@@ -125,6 +132,16 @@ FAULT_RECOVERY_KINDS: dict[str, frozenset] = {
     "snap_torn": frozenset({"snapshot_flush", "checkpoint_save", "restore"}),
     "snap_slow": frozenset({"snapshot_flush", "checkpoint_save"}),
     "snap_corrupt": frozenset({"restore"}),
+    # Slice-granular seams (ISSUE 18): a whole-slice loss is recovered by
+    # the survivors' elastic resume at the shrunk DP width (the cross-slice
+    # buddy tier supplies the state, so its restore verdict precedes the
+    # resume); a flapping slice by the federation ledger demonstrably
+    # holding it in cooldown (a slice_state transition) instead of
+    # thrashing the fleet. dcn_partition and slice_slow recover by simply
+    # completing — replication resumes / the spread detector flags the
+    # outlier — so, like straggler, they carry no entry here.
+    "slice_loss": frozenset({"elastic_resume"}),
+    "slice_flap": frozenset({"slice_state"}),
 }
 
 # Autopilot correlation contract (ISSUE 11): every autopilot_decision must
@@ -139,6 +156,10 @@ DECISION_RECOVERY_KINDS: dict[str, frozenset] = {
     "quarantine_rerun": frozenset({"sdc_rerun", "elastic_resume"}),
     "deopt_escalate": frozenset({"compile_deopt"}),
     "checkpoint_halt": frozenset({"checkpoint_save"}),
+    # Fleet actuators (ISSUE 18): a shrink/regrow decision actuates as the
+    # elastic resume that re-enters training at the new DP width.
+    "shrink_dp": frozenset({"elastic_resume"}),
+    "regrow_dp": frozenset({"elastic_resume"}),
 }
 
 
@@ -432,7 +453,7 @@ def replay_events(
                 decision_events.append((lineno, str(rec["actuator"]), rec))
             elif kind in ("executor_demoted", "compile_deopt", "nan_guard",
                           "cache_repair", "collective_timeout",
-                          "elastic_resume"):
+                          "elastic_resume", "slice_state"):
                 recovery_positions.setdefault(kind, []).append(lineno)
             elif kind in ("checkpoint_save", "sdc_rerun", "snapshot_flush",
                           "restore"):

@@ -41,6 +41,12 @@ recompile_storm    ≥ ``recompile_threshold`` recompile ``compile_end``
 host_spread        slowest host mean / fleet median past
                    ``spread_threshold`` with ≥2 hosts reporting — the
                    incremental form of ``host_health``'s straggler flag
+slice_spread       slowest slice mean / cross-slice median past
+                   ``slice_spread_threshold`` with ≥2 slices reporting —
+                   the DCN-tier twin of ``host_spread`` (ISSUE 18): one
+                   whole slice lagging the federation behind its DCN link,
+                   attributed as ``suspect_host="slice<N>"`` so the
+                   autopilot's strike ledger accumulates against the slice
 =================  ==========================================================
 
 Module-top imports are stdlib-only (the bank is installed from the event
@@ -306,6 +312,9 @@ class DetectorConfig:
     spread_threshold: float = 1.5
     spread_min_steps: int = 4
     spread_consecutive: int = 3
+    # Cross-slice (DCN-tier) spread: lower bar than the in-slice host
+    # spread — a whole slice lagging is a federation-level event (ISSUE 18).
+    slice_spread_threshold: float = 1.3
     # Samples a tripped detector stays quiet before re-arming (one drift =
     # one anomaly, then periodic re-alerts while it persists).
     cooldown: int = 16
@@ -372,6 +381,9 @@ class DetectorBank:
         self._spread_acc = HostHealthAccumulator()
         self._spread_hits = 0
         self._spread_quiet = 0
+        self._slice_acc = HostHealthAccumulator()
+        self._slice_hits = 0
+        self._slice_quiet = 0
         self.anomalies: deque = deque(maxlen=self.config.max_anomalies)
         self.consumed = 0
 
@@ -421,6 +433,7 @@ class DetectorBank:
         if hit:
             out.append(self._anomaly("goodput_drop", "ewma_ratio", hit, fn=fn))
         out.extend(self._on_spread(fields, s))
+        out.extend(self._on_slice_spread(fields, s))
         return out
 
     def _on_spread(self, fields: dict, s: float) -> list:
@@ -455,6 +468,54 @@ class DetectorBank:
              "window": [round(st["mean_s"], 6) for st in stats.values()]},
             suspect_host=slow,
         )]
+
+    def _on_slice_spread(self, fields: dict, s: float) -> list:
+        cfg = self.config
+        sl = fields.get("slice")
+        if sl is None:
+            try:
+                from thunder_tpu.resilience.chaos import slice_id
+
+                sl = slice_id()
+            except Exception:
+                return []
+        self._slice_acc.add(int(sl), s)
+        if len(self._slice_acc) < 2:
+            return []
+        stats = self._slice_acc.host_stats()
+        if min(st["steps"] for st in stats.values()) < cfg.spread_min_steps:
+            return []
+        median, spread = self._slice_acc.spread()
+        if spread <= cfg.slice_spread_threshold:
+            self._slice_hits = 0
+            return []
+        if self._slice_quiet > 0:
+            self._slice_quiet -= 1
+            return []
+        self._slice_hits += 1
+        if self._slice_hits < cfg.spread_consecutive:
+            return []
+        self._slice_hits = 0
+        self._slice_quiet = cfg.cooldown
+        slow = max(stats, key=lambda h: stats[h]["mean_s"])
+        return [self._anomaly(
+            "slice_spread", "spread",
+            {"value": spread, "baseline": cfg.slice_spread_threshold,
+             "window": [round(st["mean_s"], 6) for st in stats.values()]},
+            suspect_host=f"slice{slow}",
+        )]
+
+    def note_slice_step(self, slice_: int, s: float) -> None:
+        """Direct per-slice step-time feed for federated drivers (ISSUE 18):
+        the emulated fleet runs every slice in one process, so host-keyed
+        ``step_time`` events cannot separate the slices — the driver calls
+        this instead with the per-slice wall time (the ``slice_step_time``
+        hook of ``run_federated_training``)."""
+        raised: list[Anomaly] = []
+        with self._lock:
+            raised = self._on_slice_spread({"slice": int(slice_)}, float(s))
+        for a in raised:
+            self._publish(a)
 
     def _on_recompile(self) -> list:
         hit = self._recompiles.tick()
@@ -534,11 +595,30 @@ class DetectorBank:
             ],
         }
 
+    def slice_spread_state(self) -> Optional[dict]:
+        """Online DCN-tier spread snapshot (None until ≥2 slices reported)
+        — the /healthz federation component's slow-slice flag (ISSUE 18)."""
+        with self._lock:
+            if len(self._slice_acc) < 2:
+                return None
+            median, spread = self._slice_acc.spread()
+            stats = self._slice_acc.host_stats()
+        return {
+            "spread_ratio": round(spread, 4),
+            "slices": len(stats),
+            "slow_slices": [
+                sl for sl, st in sorted(stats.items(), key=lambda kv: str(kv[0]))
+                if median
+                and st["mean_s"] > self.config.slice_spread_threshold * median
+            ],
+        }
+
     def debug_state(self) -> dict:
         with self._lock:
             return {
                 "consumed": self.consumed,
                 "step_streams": sorted(self._step),
+                "slices": len(self._slice_acc),
                 "recompile_window": len(self._recompiles._ticks),
                 "anomalies": [
                     dict(a.as_event_fields(), ts=round(a.ts, 3))

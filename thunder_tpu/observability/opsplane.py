@@ -214,6 +214,10 @@ def health_verdict(plane: Optional["OpsPlane"] = None, *,
     - ``host_health`` — the detector bank's ONLINE spread when ≥2 hosts
       reported, else the last offline ``host_health`` summary; stragglers
       → degraded;
+    - ``federation`` — per-slice fleet health when a membership ledger is
+      installed (ISSUE 18): lost/cooldown slices or a DCN-tier slow slice
+      → degraded, zero surviving width → critical; absent on unfederated
+      runs;
     - ``deopt`` — the process-wide max de-opt ladder level (any de-opted
       function → degraded: the process is trading speed for survival);
     - ``checkpoint`` — in-flight background flushes; one stuck past
@@ -268,6 +272,31 @@ def health_verdict(plane: Optional["OpsPlane"] = None, *,
     comp("host_health", hh_status,
          {"spread_ratio": spread, "stragglers": stragglers},
          f"straggler suspect(s): {stragglers}")
+
+    from thunder_tpu.resilience import federation as fed_mod
+
+    ledger = fed_mod.current_ledger()
+    if ledger is not None:
+        fed = ledger.debug_state()
+        lost = [r["slice"] for r in fed["slices"] if r["state"] == "lost"]
+        cooldown = [r["slice"] for r in fed["slices"]
+                    if r["state"] == "cooldown"]
+        slow = None
+        if plane is not None and plane.bank is not None:
+            ss = plane.bank.slice_spread_state()
+            if ss is not None:
+                slow = ss["slow_slices"]
+        fed_status = "ok"
+        if cooldown or slow:
+            fed_status = "degraded"
+        if lost:
+            fed_status = "degraded" if fed["width"] else "critical"
+        comp("federation", fed_status,
+             {"width": fed["width"], "n_slices": fed["n_slices"],
+              "lost_slices": lost, "cooldown_slices": cooldown,
+              "slow_slices": slow},
+             f"fleet at width {fed['width']}/{fed['n_slices']} "
+             f"(lost={lost}, cooldown={cooldown}, slow={slow})")
 
     from thunder_tpu.resilience import deopt as deopt_mod
 
@@ -333,6 +362,10 @@ def debug_state(plane: Optional["OpsPlane"] = None) -> dict:
     }
     ap = ap_mod.current()
     out["autopilot"] = ap.debug_state() if ap is not None else None
+    from thunder_tpu.resilience import federation as fed_mod
+
+    ledger = fed_mod.current_ledger()
+    out["federation"] = ledger.debug_state() if ledger is not None else None
     # `is not None`, not truthiness: an EMPTY FlightRecorder is falsy
     # (it defines __len__) but very much installed.
     out["flight_recorder"] = (

@@ -11,7 +11,12 @@ Explicit trace-level collectives (the reference's distributed/prims.py
 surface) live in ``thunder_tpu.distributed``.
 """
 
-from thunder_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: F401
+from thunder_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    SliceTopology,
+    make_federated_mesh,
+    make_mesh,
+)
 from thunder_tpu.parallel.sharding import (  # noqa: F401
     data_spec,
     gpt_param_specs,

@@ -24,6 +24,11 @@ import numpy as np
 
 AXIS_ORDER = ("dp", "pp", "fsdp", "ep", "sp", "tp")
 
+# The cross-slice federation axis (ISSUE 18): a federated mesh prepends it
+# to AXIS_ORDER, so slices are the slowest-varying device groups — exactly
+# the boundary DCN links sit on. In-slice axes keep their ICI ordering.
+DCN_AXIS = "dcn"
+
 
 @dataclass(frozen=True)
 class MeshConfig:
@@ -80,3 +85,87 @@ def make_mesh(config: MeshConfig | dict | None = None, *, devices: Optional[Sequ
     shape = tuple(config.axis_sizes()[a] for a in AXIS_ORDER)
     arr = np.array(devs[:n]).reshape(shape)
     return Mesh(arr, AXIS_ORDER)
+
+
+# =============================================================================
+# Federated (slice-granular) meshes — ISSUE 18
+# =============================================================================
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    """Static description of one federated mesh: which contiguous device
+    block each emulated ICI slice owns. Slice i holds devices
+    ``[i*devices_per_slice, (i+1)*devices_per_slice)`` of the flat device
+    list — contiguous so in-slice collectives stay on "ICI" neighbours and
+    only the leading :data:`DCN_AXIS` hops cross the slice boundary."""
+
+    n_slices: int
+    devices_per_slice: int
+    per_slice: MeshConfig
+
+    @property
+    def n_devices(self) -> int:
+        return self.n_slices * self.devices_per_slice
+
+    def slice_of_device(self, flat_index: int) -> int:
+        """Slice owning flat device index ``flat_index``."""
+        return int(flat_index) // self.devices_per_slice
+
+    def device_indices(self, slice_id: int) -> range:
+        """Flat device indices of ``slice_id``'s block."""
+        lo = int(slice_id) * self.devices_per_slice
+        return range(lo, lo + self.devices_per_slice)
+
+
+def make_federated_mesh(
+    n_slices: int,
+    config: MeshConfig | dict | None = None,
+    *,
+    devices: Optional[Sequence] = None,
+    **axes,
+):
+    """Build a hierarchical ``jax.sharding.Mesh`` federating ``n_slices``
+    emulated ICI slices over a leading :data:`DCN_AXIS`.
+
+    ``config``/``axes`` describe ONE slice (the in-slice ICI mesh); the
+    returned mesh has axes ``("dcn",) + AXIS_ORDER`` and shape
+    ``(n_slices, dp, pp, fsdp, ep, sp, tp)``. Per-slice device blocks are
+    contiguous in the flat device list, so the "dcn" axis is the only axis
+    whose collectives cross a slice boundary — which is what lets
+    hierarchical lowering (``dist_prims.hier_all_reduce``) and the cost
+    model's DCN bandwidth class price in-slice vs cross-slice traffic
+    separately. Returns ``(mesh, SliceTopology)``."""
+    import jax
+    from jax.sharding import Mesh
+
+    if n_slices < 1:
+        raise ValueError(f"need at least 1 slice, got {n_slices}")
+    if config is None:
+        config = MeshConfig(**{k: int(v) for k, v in axes.items()})
+    elif isinstance(config, dict):
+        config = MeshConfig(**config)
+
+    devs = list(devices) if devices is not None else jax.devices()
+    per_slice = config.n_devices
+    n = n_slices * per_slice
+    if len(devs) < n:
+        raise ValueError(
+            f"Federated mesh needs {n} devices ({n_slices} slices × "
+            f"{per_slice}), only {len(devs)} available"
+        )
+    shape = (n_slices,) + tuple(config.axis_sizes()[a] for a in AXIS_ORDER)
+    arr = np.array(devs[:n]).reshape(shape)
+    topo = SliceTopology(n_slices=n_slices, devices_per_slice=per_slice,
+                         per_slice=config)
+    return Mesh(arr, (DCN_AXIS,) + AXIS_ORDER), topo
+
+
+def is_federated(mesh) -> bool:
+    """True when ``mesh`` carries the cross-slice :data:`DCN_AXIS`."""
+    return DCN_AXIS in tuple(getattr(mesh, "axis_names", ()) or ())
+
+
+def slice_axis_size(mesh) -> int:
+    """Number of slices a federated mesh spans (1 for a plain ICI mesh)."""
+    return axis_sizes(mesh).get(DCN_AXIS, 1)

@@ -37,7 +37,12 @@ the runtime actually walk that ladder under fault:
   policy engine that decides WHICH of the above actuators to apply when
   faults arrive mixed and concurrent, with per-policy hysteresis and
   serialized recoveries, every choice a typed ``autopilot_decision``
-  event — ISSUE 11.
+  event — ISSUE 11;
+- :mod:`~thunder_tpu.resilience.federation` — slice-granular failure
+  domains: the typed slice-membership ledger, the shrink/regrow state
+  machine (rejoin backoff + hysteresis so a flapping slice degrades the
+  fleet once), and the federated training driver over emulated ICI
+  slices — ISSUE 18.
 
 See docs/robustness.md for the fault model and the chaos spec grammar.
 """
@@ -48,6 +53,12 @@ from thunder_tpu.resilience.autopilot import (  # noqa: F401
     Policy,
     Signal,
     run_autopiloted_training,
+)
+from thunder_tpu.resilience.federation import (  # noqa: F401
+    FederationLedger,
+    FleetController,
+    FleetReport,
+    run_federated_training,
 )
 
 from thunder_tpu.resilience.chaos import (  # noqa: F401

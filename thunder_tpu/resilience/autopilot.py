@@ -34,6 +34,12 @@ Actuators (the four policy classes; ``DECISION_RECOVERY_KINDS`` in
 ``deopt_escalate``   the compile de-opt ladder climbs/jumps a level
 ``checkpoint_halt``  save a durable checkpoint and stop — the next process
                      (scheduler allocation) resumes
+``shrink_dp``        fleet-level (ISSUE 18): a slice died — shrink the
+                     data-parallel group to the survivors and rescale
+                     gradient accumulation loss-equivalently
+                     (``resilience/federation.py`` applies it)
+``regrow_dp``        fleet-level: a cooled-down slice cleared the rejoin
+                     hysteresis — reshard back to full DP width
 ===================  ========================================================
 
 Every decision is emitted as a typed ``autopilot_decision`` event carrying
@@ -77,6 +83,11 @@ from thunder_tpu.observability import metrics as obsm
 
 ACTUATORS = (
     "elastic_resume", "quarantine_rerun", "deopt_escalate", "checkpoint_halt",
+    # Fleet actuators (ISSUE 18): shrink the data-parallel group away from a
+    # lost slice / regrow it when the slice rejoins after hysteresis. Both
+    # actuate as the elastic resume that re-enters training at the new DP
+    # width (DECISION_RECOVERY_KINDS), applied by the federation driver.
+    "shrink_dp", "regrow_dp",
 )
 
 # Signal kinds the default policy table covers. Unknown kinds fall through
@@ -85,6 +96,7 @@ ACTUATORS = (
 SIGNAL_KINDS = (
     "host_loss", "collective_hang", "sdc_suspect", "sdc_persistent",
     "oom", "compile_fail", "preempt", "host_unhealthy",
+    "slice_loss", "slice_recovered",
 )
 
 
@@ -160,6 +172,16 @@ def default_policies() -> dict[str, Policy]:
         Policy("compile_fail", (("deopt_escalate", None),), window_s=60.0),
         # Preemption is an order, not a fault: save and stop.
         Policy("preempt", (("checkpoint_halt", None),), window_s=60.0),
+        # A dead SLICE (ISSUE 18) shrinks the DP group and keeps training on
+        # the survivors; two losses inside the window still shrink (the
+        # fleet has width to give), the third halts — slices are evaporating
+        # faster than the fleet can rescale. Keyed on the slice id (the
+        # signal's suspect_host), so two different flapping slices don't
+        # share a strike count.
+        Policy("slice_loss",
+               (("shrink_dp", None), ("shrink_dp", None),
+                ("checkpoint_halt", None)),
+               window_s=600.0),
     )}
 
 
@@ -295,6 +317,9 @@ class Autopilot:
         "host_unhealthy": ("step_time_drift", "goodput_drop", "host_spread"),
         "oom": ("recompile_storm",),
         "compile_fail": ("recompile_storm",),
+        # A DCN-tier spread verdict is evidence for the slice ladder: the
+        # slow slice was already a named suspect before it died (ISSUE 18).
+        "slice_loss": ("slice_spread", "goodput_drop"),
     }
 
     def _cite_anomaly(self, signal: Signal) -> Optional[dict]:
@@ -741,4 +766,20 @@ def _decide_regrow(autopilot: Autopilot, step: int, healthy: Optional[int]) -> D
         signal=Signal("host_recovered", step=step,
                       evidence={"healthy_steps": healthy}),
         actuator="elastic_resume", mode="regrow",
+    ))
+
+
+def decide_regrow_dp(autopilot: Autopilot, slice_: int, step: Optional[int],
+                     evidence: Optional[dict] = None) -> Decision:
+    """The fleet regrow decision (ISSUE 18): emitted when the federation
+    ledger promotes a cooled-down slice back to active — a recovery, not a
+    fault, so like :func:`_decide_regrow` it bypasses the policy ladder but
+    still flows through :meth:`Autopilot._record` so the decision is a
+    replay-required event like every other actuator's."""
+    return autopilot._record(Decision(
+        id=0,
+        signal=Signal("slice_recovered", step=step,
+                      suspect_host=f"slice{slice_}",
+                      evidence=dict(evidence or {})),
+        actuator="regrow_dp",
     ))

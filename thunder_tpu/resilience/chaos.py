@@ -49,6 +49,23 @@ Seams and their typed errors:
 ``snap_slow``      slow background flush — sleeps ``~<delay>`` seconds
                    inside the writer thread (recovery: the flush still
                    commits; backpressure coalesces queued snapshots)
+``slice_loss``     a whole ICI slice dies at a chosen training step
+                   (``slice=N`` clause picks the victim; recovery: the
+                   fleet controller shrinks the DP group and restores the
+                   lost replica's state from the cross-slice buddy
+                   peer-RAM tier, :mod:`~.federation`)
+``dcn_partition``  the DCN tier partitions at a chosen step — cross-slice
+                   snapshot replication is severed until healed (recovery:
+                   training continues in-slice; replication resumes when
+                   the partition heals)
+``slice_slow``     one slice's step time inflates by ``~<delay>`` seconds
+                   (``slice=N`` picks it; recovery: none required — the
+                   cross-slice spread detector must flag the outlier
+                   before any watchdog would)
+``slice_flap``     a slice enters a fail/recover loop faster than the
+                   rejoin hysteresis window (recovery: the fleet
+                   controller degrades ONCE — one shrink, one deferred
+                   regrow after the backoff clears — instead of thrashing)
 =================  =====================================================
 
 Spec grammar (``THUNDER_TPU_CHAOS=<spec>`` or ``jit(chaos=<spec>)``)::
@@ -57,7 +74,7 @@ Spec grammar (``THUNDER_TPU_CHAOS=<spec>`` or ``jit(chaos=<spec>)``)::
     component := "seed=" INT
                | seam ["@" target] ["*" count] ["%" prob] ["~" delay_s]
     target    := clause ("," clause)*
-    clause    := "host=" INT | <seam-specific target>
+    clause    := "host=" INT | "slice=" INT | <seam-specific target>
     count     := INT | "inf"          (default 1: fire once, then disarm)
     prob      := FLOAT in (0, 1]      (default 1.0; drawn from the seeded RNG)
     delay_s   := FLOAT                (straggler sleep seconds, default 0.01)
@@ -82,11 +99,14 @@ single-process simulation and tests). Examples::
 Every injection emits a ``fault_injected`` JSONL event and increments
 ``thunder_tpu_faults_injected_total{seam=...}``. Injection decisions are
 deterministic given the spec (counts + seeded RNG): the same spec replays
-the same fault schedule. The probability RNG is seeded with
-``seed + process_index()`` so every host of a multi-process job draws an
-independent — but individually replayable — stream (all hosts sharing one
-stream would make multi-process ``%prob`` schedules diverge from the
-single-host replay of the same spec).
+the same fault schedule. The probability RNG is seeded from the full
+``(seed, slice_id, host_id)`` coordinate via a stable hash, so every host
+of a federated multi-process job draws an independent — but individually
+replayable — stream. Hashing the coordinate (rather than summing into the
+seed) keeps schedules collision-free as the fleet shrinks and regrows:
+``seed + process_index()`` made host 3 of a 4-host fleet replay host 2's
+schedule after a shrink renumbered it, which is exactly the
+non-reproducibility a federated chaos soak cannot tolerate.
 """
 
 from __future__ import annotations
@@ -107,6 +127,7 @@ SEAMS = (
     "straggler", "ckpt_io", "preempt", "cache_corrupt",
     "collective_hang", "host_loss", "sdc", "sched_bad",
     "snap_torn", "snap_corrupt", "snap_slow",
+    "slice_loss", "dcn_partition", "slice_slow", "slice_flap",
 )
 
 
@@ -131,6 +152,31 @@ def process_index() -> int:
         except Exception:
             pass
     return 0
+
+
+def slice_id() -> int:
+    """This process's slice in a federated fleet: ``THUNDER_TPU_SLICE_ID``
+    when set (the federation driver and single-process emulation set it),
+    else 0 — a plain single-slice job is slice 0 of a one-slice fleet."""
+    env = os.environ.get("THUNDER_TPU_SLICE_ID", "").strip()
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    return 0
+
+
+def _derive_seed(seed: int, slice_: int, host: int) -> int:
+    """Stable per-process RNG seed from the ``(seed, slice, host)``
+    coordinate. A keyed hash, not arithmetic: ``seed + host`` collides when
+    the fleet renumbers hosts after a shrink (host 3's old schedule becomes
+    host 2's new one), and Python's ``hash()`` is per-process randomized
+    for strings — neither replays."""
+    import hashlib
+
+    h = hashlib.blake2s(f"{seed}:{slice_}:{host}".encode(), digest_size=8)
+    return int.from_bytes(h.digest(), "big")
 
 
 class ChaosError(RuntimeError):
@@ -211,6 +257,7 @@ class FaultRule:
     prob: float = 1.0
     delay_s: float = 0.01
     host: Optional[int] = None  # host=N clause: only this process fires
+    slice: Optional[int] = None  # slice=N clause: the victim/targeted slice
     fired: int = 0
 
     def exhausted(self) -> bool:
@@ -231,10 +278,12 @@ class FaultRule:
 class ChaosConfig:
     """Parsed chaos spec: rules + the seeded RNG driving probability draws.
 
-    The RNG is created lazily on first draw and seeded with
-    ``seed + process_index()``: each host of a multi-process job gets its
-    own replayable stream (laziness matters — specs parse before the jax
-    backend knows the process index)."""
+    The RNG is created lazily on first draw and seeded from the hashed
+    ``(seed, slice_id(), process_index())`` coordinate: each host of a
+    federated multi-process job gets its own replayable stream that stays
+    collision-free across fleet shrink/regrow renumbering (laziness
+    matters — specs parse before the jax backend knows the process
+    index)."""
 
     rules: list = field(default_factory=list)
     seed: int = 0
@@ -245,7 +294,9 @@ class ChaosConfig:
     @property
     def rng(self) -> random.Random:
         if self._rng is None:
-            self._rng = random.Random(self.seed + process_index())
+            self._rng = random.Random(
+                _derive_seed(self.seed, slice_id(), process_index())
+            )
         return self._rng
 
     def rules_for(self, seam: str):
@@ -288,12 +339,13 @@ def parse_spec(spec: str) -> ChaosConfig:
             plain = []
             for clause in target.split(","):
                 clause = clause.strip()
-                if clause.startswith("host="):
+                if clause.startswith("host=") or clause.startswith("slice="):
+                    attr, _, val = clause.partition("=")
                     try:
-                        rule.host = int(clause[len("host="):])
+                        setattr(rule, attr, int(val))
                     except ValueError:
                         raise ValueError(
-                            f"chaos spec: malformed host clause {clause!r} "
+                            f"chaos spec: malformed {attr} clause {clause!r} "
                             f"in component {comp!r}"
                         ) from None
                 elif clause:
@@ -595,6 +647,91 @@ def _step_seam_fires(seam: str, step: int) -> bool:
         _record(rule, str(step))
         return True
     return False
+
+
+# -- slice-granular seams (federated fleets, resilience/federation.py) ---------
+
+
+def _slice_step_seam(seam: str, step: int) -> Optional[int]:
+    """Exact-step slice seam: the victim slice id when an armed rule fires
+    at ``step`` (``slice=N`` clause, default slice 0), else None."""
+    cfg = active()
+    if cfg is None:
+        return None
+    for rule in cfg.rules_for(seam):
+        if rule.exhausted() or not rule.host_matches():
+            continue
+        if rule.target is not None and rule.target != str(step):
+            continue
+        if rule.prob < 1.0 and cfg.rng.random() >= rule.prob:
+            continue
+        rule.fired += 1
+        victim = rule.slice if rule.slice is not None else 0
+        _record(rule, f"step{step}:slice{victim}")
+        return victim
+    return None
+
+
+def slice_loss_at_step(step: int) -> Optional[int]:
+    """Federated training-loop seam: the slice id an armed ``slice_loss``
+    rule kills at this step (``slice_loss@3,slice=1``), or None. The fleet
+    controller (``resilience/federation.py``) shrinks the DP group, rescales
+    gradient accumulation, and restores the lost replica's contribution
+    from the victim's cross-slice buddy peer-RAM snapshot."""
+    return _slice_step_seam("slice_loss", step)
+
+
+def slice_flap_at_step(step: int) -> Optional[int]:
+    """Federated training-loop seam: the slice id an armed ``slice_flap``
+    rule starts flapping at this step — the driver runs it through a
+    fail/recover loop faster than the rejoin hysteresis window, and the
+    fleet controller must degrade ONCE (one shrink, one deferred regrow)."""
+    return _slice_step_seam("slice_flap", step)
+
+
+def dcn_partition_at_step(step: int) -> Optional[FaultRule]:
+    """Federated training-loop seam: the armed ``dcn_partition`` rule firing
+    at this step (exact-step target), else None. The caller severs
+    cross-slice snapshot replication (``SnapshotStore.partitioned``) and
+    heals it after the rule's ``~<delay>`` seconds — or at its own healing
+    boundary — while training continues in-slice."""
+    cfg = active()
+    if cfg is None:
+        return None
+    for rule in cfg.rules_for("dcn_partition"):
+        if rule.exhausted() or not rule.host_matches():
+            continue
+        if rule.target is not None and rule.target != str(step):
+            continue
+        if rule.prob < 1.0 and cfg.rng.random() >= rule.prob:
+            continue
+        rule.fired += 1
+        _record(rule, str(step))
+        return rule
+    return None
+
+
+def slice_slow_delay(slice_: int) -> float:
+    """Federated step-path seam: seconds slice ``slice_``'s step inflates by
+    when an armed ``slice_slow`` rule targets it (``slice=N`` clause;
+    untargeted rules slow every slice they're asked about). The cross-slice
+    step-time spread detector (observability/detect.py) must flag the
+    outlier slice from exactly this drift."""
+    cfg = active()
+    if cfg is None:
+        return 0.0
+    total = 0.0
+    for rule in cfg.rules_for("slice_slow"):
+        if rule.exhausted() or not rule.host_matches():
+            continue
+        if rule.slice is not None and rule.slice != slice_:
+            continue
+        if rule.prob < 1.0 and cfg.rng.random() >= rule.prob:
+            continue
+        rule.fired += 1
+        _record(rule, f"slice{slice_}")
+        total += rule.delay_s
+    return total
 
 
 def collective_hang_seam() -> None:

@@ -723,12 +723,51 @@ class CheckpointManager:
 
     # -- restore --------------------------------------------------------------
 
+    def _sweep_stale_tmps(self) -> int:
+        """Sweep orphan ``step_*.tmp`` dirs at restore time. A writer that
+        died mid-flush (between the tmp write and the rename) leaves its
+        tmp behind forever: ``_gc`` only reaps tmps OLDER than the newest
+        complete step, so a crash mid-flush of the newest step accumulated
+        debris across every resume cycle of a chaos soak. At restore entry
+        no tmp can still become a checkpoint — the writer that owned it is
+        gone and a live background flush publishes under its own step
+        (skipped here via ``_inflight_step``) — so everything else found is
+        stale. Primary-only, like the rest of the sweep; logged as a
+        ``ckpt_tmp_sweep`` event so the accumulation is visible instead of
+        silent. Returns the number of dirs swept."""
+        if not _is_primary():
+            return 0
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return 0
+        inflight = self._inflight_step
+        swept = []
+        for name in sorted(names):
+            if not (name.startswith("step_") and name.endswith(".tmp")):
+                continue
+            try:
+                step = int(name[len("step_"):-len(".tmp")])
+            except ValueError:
+                step = -1
+            if inflight is not None and step == inflight:
+                continue
+            shutil.rmtree(os.path.join(self.directory, name),
+                          ignore_errors=True)
+            swept.append(step)
+        if swept:
+            obs_events.emit_event("ckpt_tmp_sweep", count=len(swept),
+                                  steps=swept)
+        return len(swept)
+
     def restore(self) -> tuple[Any, dict]:
         """(state, meta) from the newest COMPLETE checkpoint. A step that
         exists but is incomplete (no META — torn write) or fails to load
         (corrupted payload) is quarantined as ``.corrupt`` and the next
         newest complete step is tried; :class:`CheckpointRestoreError` when
-        none remain."""
+        none remain. Entry first sweeps orphan ``*.tmp`` debris left by
+        writers that died mid-flush (:meth:`_sweep_stale_tmps`)."""
+        self._sweep_stale_tmps()
         candidates = [s for s in reversed(self.steps_on_disk())]
         tried = []
         for step in candidates:

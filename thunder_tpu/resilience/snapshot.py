@@ -118,23 +118,43 @@ class SnapshotStore:
         self._ring: deque = deque(maxlen=self.ring)
         self._replicas: dict[int, deque] = {}  # origin host -> ring of copies
         self.buddy: Optional["SnapshotStore"] = None
+        # DCN-partition switch (chaos seam ``dcn_partition``): while True,
+        # cross-boundary replication AND peer reads are severed — the buddy
+        # is unreachable, not just write-blocked.
+        self.partitioned = False
         self._lock = threading.Lock()
 
     @staticmethod
     def pair(a: "SnapshotStore", b: "SnapshotStore") -> None:
-        """Mutual buddies — the 2-host wiring the soak uses. (A larger
-        fleet would ring them: buddy of host i = store (i+1) % n.)"""
+        """Mutual buddies — the 2-host wiring the soak uses."""
         a.buddy, b.buddy = b, a
+
+    @classmethod
+    def make_ring(cls, stores: list) -> None:
+        """Ring-wire a fleet: buddy of store i = store (i+1) % n — how a
+        federated pod assigns each slice's replication target ACROSS the
+        DCN boundary, so a whole-slice loss always leaves a surviving buddy
+        holding the victim's replicas. Two stores degenerate to
+        :meth:`pair`."""
+        n = len(stores)
+        if n < 2:
+            raise ValueError(f"a buddy ring needs >= 2 stores, got {n}")
+        for i, s in enumerate(stores):
+            s.buddy = stores[(i + 1) % n]
 
     # -- writes ---------------------------------------------------------------
 
     def put(self, snap: Snapshot) -> bool:
         """File ``snap`` in the local ring and replicate it to the buddy.
         Returns True when a buddy held a replica (the ``snapshot`` event's
-        ``replicated`` field)."""
+        ``replicated`` field). A DCN partition (``partitioned`` on either
+        end) severs replication: the local ring still fills, the buddy
+        holds nothing new — honest degraded durability, reported as
+        ``replicated=False``."""
         with self._lock:
             self._ring.append(snap)
-        if self.buddy is not None:
+        if (self.buddy is not None and not self.partitioned
+                and not self.buddy.partitioned):
             self.buddy.receive(self.host, snap.share())
             return True
         return False
@@ -163,8 +183,9 @@ class SnapshotStore:
 
     def peer_snapshots(self) -> list:
         """This host's replicas as held by the buddy, newest first — the
-        peer RAM tier of the restore ladder."""
-        if self.buddy is None:
+        peer RAM tier of the restore ladder. Unreachable (empty) while
+        either end is DCN-partitioned."""
+        if self.buddy is None or self.partitioned or self.buddy.partitioned:
             return []
         with self.buddy._lock:
             ring = self.buddy._replicas.get(self.host)
