@@ -69,7 +69,7 @@ def build_forward(cfg_name: str, batch: int, seq: int):
     extrace = transform_for_execution(comp, _executors())
     trace_s = time.perf_counter() - t0
     flat_args, _ = tree_flatten(((params, idx), {}))
-    return extrace.python_callable(), flat_args, init_s, trace_s
+    return extrace.python_callable(), flat_args, init_s, trace_s, extrace
 
 
 def build_train(cfg_name: str, batch: int, seq: int):
@@ -200,7 +200,7 @@ def _bench_forward():
 
     import jax
 
-    flat_fn, flat_args, init_s, trace_s = build_forward("open_llama_3b", FWD_B, FWD_T)
+    flat_fn, flat_args, init_s, trace_s, extrace = build_forward("open_llama_3b", FWD_B, FWD_T)
     t0 = time.perf_counter()
     if os.environ.get("THUNDER_BENCH_AUTOLAYOUT", "1") == "0":
         jfn = jax.jit(flat_fn)
@@ -233,19 +233,28 @@ def _bench_forward():
     print(f"# fwd param-init: {init_s:.1f}s trace+claim: {trace_s:.1f}s compile: {compile_s:.1f}s "
           f"avg of 5 batched-dispatch runs: {avg:.4f}s",
           file=sys.stderr)
-    return avg, trace_s, compile_s, jfn, flat_args
+    return avg, trace_s, compile_s, jfn, flat_args, extrace
 
 
-def _bench_attribution(jfn, flat_args, steps: int = 2):
-    """Top-5 per-op device-time attribution of the forward (ISSUE 5): two
+def _bench_attribution(jfn, flat_args, steps: int = 2, trace=None, top_k: int = 10):
+    """Per-op device-time attribution of the forward (ISSUE 5): two
     profiler-bracketed dispatches, HLO scopes mapped back to trace lines.
-    Returns {"coverage_pct", "top5": [...]} or None when the backend has no
-    profiler plugin / the trace carries no scopes — never fails the bench."""
+    Returns {"coverage_pct", "top5", "topk", "_join"} or None when the
+    backend has no profiler plugin / the trace carries no scopes — never
+    fails the bench.
+
+    ``top5`` keeps the original print-table shape; ``topk`` (ISSUE 19) is
+    the structured per-op series — measured us joined against the static
+    cost model's roofline ceiling when ``trace`` (the execution TraceCtx)
+    is given — that history tooling and the roofline-ledger gate consume
+    from the BENCH json. ``_join`` is the in-process PerfJoin for the
+    ROOFLINE_r*.json writer; main() pops it before serializing."""
     import tempfile
 
     try:
         import thunder_tpu as ttpu
-        from thunder_tpu.observability.attribution import attribute
+        from thunder_tpu.observability.attribution import (
+            attribute, join_cost_attribution)
 
         hlo_text = None
         try:
@@ -269,6 +278,15 @@ def _bench_attribution(jfn, flat_args, steps: int = 2):
             print("# attribution skipped: no L<idx>.<sym> scopes in the profile "
                   "(THUNDER_TPU_ANNOTATE_TRACES not active at codegen?)", file=sys.stderr)
             return None
+        cost = None
+        if trace is not None:
+            try:
+                from thunder_tpu.analysis.cost import trace_cost
+
+                cost = trace_cost(trace, None)
+            except Exception as e:
+                print(f"# cost join skipped ({type(e).__name__}: {e})", file=sys.stderr)
+        join = join_cost_attribution(attr, cost, steps=steps)
         top5 = [
             {
                 "line": ref.label,
@@ -279,15 +297,112 @@ def _bench_attribution(jfn, flat_args, steps: int = 2):
             }
             for ref, us in attr.top(5)
         ]
+        topk = [
+            {
+                "line": r.label,
+                "sym": r.sym,
+                "pass": r.pass_name,
+                "us_per_step": round(r.measured_us, 1),
+                "share_pct": round(r.share * 100.0, 1),
+                "flops": r.flops,
+                "bytes": r.bytes_moved,
+                "roofline_us": (round(r.roofline_us, 1)
+                                if r.roofline_us is not None else None),
+                "achieved_frac": (round(r.efficiency, 4)
+                                  if r.efficiency is not None else None),
+                "bound": r.bound,
+            }
+            for r in join.rows[:top_k]
+        ]
         print("# fwd attribution (top 5 of "
               f"{attr.device_busy_us / steps / 1e3:.1f} ms device-busy/step, "
               f"{attr.coverage * 100:.0f}% attributed):", file=sys.stderr)
         for row in top5:
             print(f"#   {row['line']:<40} {row['us_per_step']:>9}us {row['share_pct']:>5}%",
                   file=sys.stderr)
-        return {"coverage_pct": round(attr.coverage * 100.0, 1), "top5": top5}
+        return {"coverage_pct": round(attr.coverage * 100.0, 1),
+                "top5": top5, "topk": topk, "_join": join}
     except Exception as e:
         print(f"# attribution skipped ({type(e).__name__}: {e})", file=sys.stderr)
+        return None
+
+
+def _op_flat_key(label: str, taken) -> str:
+    """Flatten one op scope into a stable per-round metric key:
+    ``L154.exp#Delete_Last_Used`` -> ``op_L154_exp`` (pass provenance
+    dropped — line+sym identify the op across rounds; rare collisions get
+    a numeric suffix so no row silently shadows another)."""
+    import re
+
+    scope = label.split("#", 1)[0]
+    key = "op_" + re.sub(r"[^0-9A-Za-z]+", "_", scope).strip("_")
+    base, n = key, 2
+    while key in taken:
+        key = f"{base}_{n}"
+        n += 1
+    taken.add(key)
+    return key
+
+
+def _roofline_result(ledger, *, metric: str, device_spec, probes: int,
+                     coverage_pct, flat_top_k: int = 12) -> dict:
+    """One ROOFLINE_r*.json round from a folded ledger: the full per-op
+    ``rows`` series (the committed schema of observability/roofline.py's
+    ``ROW_FIELDS``) plus top-k per-op numerics flattened to top level —
+    ``op_<line>_<sym>_us`` / ``_achieved_frac`` — which is what
+    scripts/perf_report.py's direction-aware history gate actually
+    compares (exposed time up / achieved fraction down on a named op
+    fails the gate)."""
+    from thunder_tpu.observability.roofline import ROW_FIELDS
+
+    rows = ledger.snapshot()["rows"]
+    busy_ms = sum(r["measured_us"] for r in rows) / 1e3
+    schema_ok = all(set(r) == set(ROW_FIELDS) for r in rows)
+    result = {
+        "metric": metric,
+        "value": round(busy_ms, 4),
+        "unit": "ms_device_busy_per_step",
+        "device_spec": device_spec,
+        "probes": probes,
+        "roofline_rows": len(rows),
+        "roofline_schema_ok": 1 if schema_ok else 0,
+        "roofline_coverage_pct": coverage_pct,
+        "rows": rows,
+    }
+    taken: set = set()
+    for r in rows[:flat_top_k]:
+        key = _op_flat_key(r["label"], taken)
+        result[f"{key}_us"] = r["measured_us"]
+        if r["achieved_frac"] is not None:
+            result[f"{key}_achieved_frac"] = r["achieved_frac"]
+    return result
+
+
+def _write_roofline_round(join, out_path: str, *, metric: str, probes: int = 1):
+    """Fold a PerfJoin (or several — ``probes`` says how many) into a fresh
+    ledger and commit it as a ROOFLINE round. Never fails the bench."""
+    try:
+        from thunder_tpu.observability.roofline import RooflineLedger
+
+        ledger = RooflineLedger()
+        joins = join if isinstance(join, list) else [join]
+        for j in joins:
+            ledger.fold(j)
+        last = joins[-1]
+        device_spec = (last.cost.device.name
+                       if getattr(last, "cost", None) is not None else None)
+        result = _roofline_result(
+            ledger, metric=metric, device_spec=device_spec, probes=probes,
+            coverage_pct=round(last.attribution.coverage * 100.0, 1))
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+        print(f"# roofline round: {result['roofline_rows']} op rows "
+              f"({result['value']:.3f} ms device-busy/step) -> {out_path}",
+              file=sys.stderr)
+        return result
+    except Exception as e:
+        print(f"# roofline round skipped ({type(e).__name__}: {e})", file=sys.stderr)
         return None
 
 
@@ -578,7 +693,8 @@ def main() -> None:
     # populated observability snapshot (ISSUE 4: BENCH_*.json embeds it).
     monitor.enable()
     recompile_count, lookup_us = _bench_cache()
-    fwd_avg, fwd_trace_s, fwd_compile_s, fwd_jfn, fwd_args = _bench_forward()
+    (fwd_avg, fwd_trace_s, fwd_compile_s, fwd_jfn, fwd_args,
+     fwd_extrace) = _bench_forward()
     (train_avg, train_synced, train_strict, train_total,
      train_trace_s, train_compile_s, train_phases) = _bench_train()
     # Profile LAST among the compiling benches: the gated compile-seconds
@@ -587,7 +703,15 @@ def main() -> None:
     # r4->r5 diagnosis had to refute exactly this hypothesis by experiment
     # — see BENCHMARKS.md "compile-phase diagnosis"; ordering it out keeps
     # the refutation permanent).
-    attribution = _bench_attribution(fwd_jfn, fwd_args)
+    attribution = _bench_attribution(fwd_jfn, fwd_args, trace=fwd_extrace)
+    # The roofline per-op series (ISSUE 19): the same join, committed as a
+    # ROOFLINE_r*.json round when the driver asks for one. Pop the live
+    # PerfJoin either way — it is not JSON.
+    fwd_join = attribution.pop("_join", None) if attribution else None
+    roofline_out = os.environ.get("THUNDER_TPU_ROOFLINE_OUT")
+    if roofline_out and fwd_join is not None:
+        _write_roofline_round(fwd_join, roofline_out,
+                              metric="roofline_open_llama_3b_fwd")
     # The end-to-end XLA compile totals as labelled histogram samples — the
     # metric whose 2x jump (r4->r5) per-pass ms could not see (ISSUE 5).
     obsm.XLA_COMPILE_S.observe(fwd_compile_s, cls="bench_forward")
@@ -695,5 +819,70 @@ def main() -> None:
     print(json.dumps(result))
 
 
+def roofline_main(argv) -> None:
+    """``python bench.py --roofline-out PATH [--model gpt-tiny] [--batch B]
+    [--seq T] [--every N] [--probes K]`` — the light roofline-only bench
+    (ISSUE 19): arm the duty-cycled RooflineSampler on a jitted forward,
+    run ``every*probes`` steps so exactly ``probes`` of them profile, and
+    commit the folded ledger as a ROOFLINE_r*.json per-op round. Small
+    models on purpose: this path must run wherever CI does (CPU included),
+    unlike the 3B main() workload; the env-driven
+    THUNDER_TPU_ROOFLINE_OUT hook in main() covers the TPU bench."""
+    import os
+
+    os.environ.setdefault("THUNDER_TPU_ANNOTATE_TRACES", "1")
+
+    def opt(name, default):
+        return argv[argv.index(name) + 1] if name in argv else default
+
+    out_path = opt("--roofline-out", "ROOFLINE_r01.json")
+    model = opt("--model", "gpt-tiny")
+    batch = int(opt("--batch", 2))
+    seq = int(opt("--seq", 32))
+    every = int(opt("--every", 2))
+    probes = int(opt("--probes", 3))
+    executors = opt("--executors", "jax").split(",")
+
+    import thunder_tpu as ttpu
+    from thunder_tpu.api import _ensure_runtime
+    from thunder_tpu.core.pytree import tree_flatten
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.observability.roofline import RooflineSampler
+
+    _ensure_runtime()
+    cfg = m.name_to_config(model)
+    params = m.init_params(cfg)
+    idx = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    jfn = ttpu.jit(lambda p, i: m.forward(p, i, cfg), executors=executors)
+    jfn(params, idx)  # compile outside the sampled loop
+
+    sampler = RooflineSampler(jfn, every=every)
+    for _ in range(every * probes):
+        sampler.maybe_sample(jfn, params, idx)
+    if sampler.probes != probes or len(sampler.ledger) == 0:
+        print(f"# roofline bench failed: {sampler.probes}/{probes} probes, "
+              f"{len(sampler.ledger)} ledger ops", file=sys.stderr)
+        raise SystemExit(1)
+    device_spec = (sampler._cost.device.name
+                   if sampler._cost is not None else None)
+    coverage = (round(sampler.last_coverage * 100.0, 1)
+                if sampler.last_coverage is not None else None)
+    result = _roofline_result(
+        sampler.ledger, metric=f"roofline_{model.replace('-', '_')}_fwd",
+        device_spec=device_spec, probes=sampler.probes,
+        coverage_pct=coverage)
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    print(sampler.ledger.format(top_k=10), file=sys.stderr)
+    print(f"# roofline round: {result['roofline_rows']} op rows -> {out_path}",
+          file=sys.stderr)
+    print(json.dumps({k: v for k, v in result.items() if k != "rows"}))
+
+
 if __name__ == "__main__":
+    if "--roofline-out" in sys.argv:
+        roofline_main(sys.argv[1:])
+        raise SystemExit(0)
     main()

@@ -1556,6 +1556,155 @@ def _ops_smoke() -> int:
     return n_errors
 
 
+def _roofline_smoke() -> int:
+    """--roofline: continuous roofline ledger smoke (ISSUE 19;
+    docs/performance.md "continuous roofline ledger"). On the CPU backend,
+    asserts the tentpole acceptance behaviors end to end: a duty-cycled
+    sampler on a gpt-tiny forward produces a schema-valid per-op ledger
+    (>= 10 rows, every row in roofline.ROW_FIELDS) served live at
+    /debug/roofline; a seeded mispriced op (its static roofline bound
+    deflated 8x under the detectors' feet) trips a typed cost_model_drift
+    anomaly through the DetectorBank; the armed-but-not-due per-step cost
+    stays under 1% of the step; and with sampling off, zero probes run.
+    Ends with the committed ROOFLINE_r*.json series gate. Returns the
+    error count."""
+    import json
+    import time
+    import urllib.error
+    import urllib.request
+
+    # Before any jit: annotated codegen is what stamps L<idx>.<sym> scopes
+    # into HLO metadata so profiler rows attribute back to trace lines.
+    os.environ.setdefault("THUNDER_TPU_ANNOTATE_TRACES", "1")
+
+    import thunder_tpu as ttpu
+    import thunder_tpu.monitor as monitor
+    from thunder_tpu.models import gpt as m
+    from thunder_tpu.observability import roofline as roofline_mod
+    from thunder_tpu.observability.detect import DetectorConfig
+    from thunder_tpu.observability.roofline import ROW_FIELDS, RooflineSampler
+
+    n_errors = 0
+    plane = monitor.serve(port=0,
+                          detectors=DetectorConfig(min_samples=6, cooldown=20))
+    print(f"--- roofline smoke: ops server on 127.0.0.1:{plane.port}")
+
+    def get(route):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{plane.port}{route}", timeout=10) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    cfg = m.name_to_config("gpt-tiny")
+    params = m.init_params(cfg)
+    idx = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 32)).astype(np.int32)
+    jf = ttpu.jit(lambda p, i: m.forward(p, i, cfg), executors=["jax"])
+    jf(params, idx)  # compile outside the sampled loop
+    t0 = time.perf_counter()
+    for _ in range(5):
+        np.asarray(jf(params, idx))
+    step_s = (time.perf_counter() - t0) / 5
+
+    # OFF (the default: THUNDER_TPU_ROOFLINE_EVERY unset -> every=0):
+    # maybe_sample must never probe.
+    off = RooflineSampler(jf)
+    for _ in range(8):
+        off.maybe_sample(jf, params, idx)
+    if off.every != 0 or off.probes != 0 or len(off.ledger) != 0:
+        n_errors += 1
+        print(f"    FAILED: sampler off still probed (every={off.every}, "
+              f"probes={off.probes})")
+    else:
+        print("    off OK: every=0 by default, 8 steps, zero probes")
+
+    # ON: every=4 over 12 steps = exactly 3 probes; the ledger must come
+    # back schema-valid with enough per-op rows to be a baseline.
+    sampler = monitor.roofline(jf, every=4)
+    for _ in range(12):
+        sampler.maybe_sample(jf, params, idx)
+    snap = sampler.ledger.snapshot()
+    bad_rows = [r for r in snap["rows"] if set(r) != set(ROW_FIELDS)]
+    priced = [r for r in snap["rows"] if r["roofline_us"] is not None]
+    if (sampler.probes != 3 or snap["ops"] < 10 or bad_rows
+            or len(priced) < 10):
+        n_errors += 1
+        print(f"    FAILED: ledger (probes={sampler.probes}, "
+              f"ops={snap['ops']}, schema violations={len(bad_rows)}, "
+              f"priced rows={len(priced)})")
+    else:
+        print(f"    ledger OK: 12 steps -> 3 probes, {snap['ops']} op rows, "
+              f"schema-valid, {len(priced)} with roofline ceilings")
+
+    code, body = get("/debug/roofline")
+    live = json.loads(body) if code == 200 else {}
+    if code != 200 or not live.get("enabled") \
+            or live.get("ledger", {}).get("ops") != snap["ops"]:
+        n_errors += 1
+        print(f"    FAILED: /debug/roofline ({code}: {body[:120]})")
+    else:
+        print(f"    /debug/roofline OK: live ledger, "
+              f"{live['ledger']['ops']} ops, {live['probes']} probes")
+
+    # Seeded mispriced op: deflate the hottest op's static bound 8x in the
+    # sampler's cost rows — the next probes' measured/predicted ratio walks
+    # out of the band and the DetectorBank must raise cost_model_drift.
+    top = sampler.ledger.rows()[0]
+    seeded = 0
+    for r in sampler._cost.rows:
+        if r.sym == top.sym and r.index == top.line:
+            r.roofline_s /= 8.0
+            seeded += 1
+    tripped = None
+    for i in range(10):
+        sampler.sample(jf, params, idx)
+        kinds = [a.kind for a in plane.bank.recent_anomalies()]
+        if "cost_model_drift" in kinds:
+            tripped = i + 1
+            break
+    if not seeded or tripped is None:
+        n_errors += 1
+        print(f"    FAILED: seeded mispriced op ({top.label}, {seeded} cost "
+              f"row(s) deflated) raised no cost_model_drift "
+              f"(anomalies={sorted(set(kinds))})")
+    else:
+        a = next(a for a in plane.bank.recent_anomalies()
+                 if a.kind == "cost_model_drift")
+        print(f"    drift OK: {top.label} deflated 8x -> cost_model_drift "
+              f"({a.severity}, ratio {a.value / a.baseline:.1f}x baseline) "
+              f"after {tripped} probe(s)")
+
+    # Overhead: the armed-but-not-due per-step cost is tick()'s counter
+    # bump + modulo (maybe_sample then dispatches fn unchanged). Composed
+    # against the measured step like bench.py's obs-overhead protocol.
+    N = 50_000
+    armed = RooflineSampler(jf, every=10**9)
+    t0 = time.perf_counter()
+    for _ in range(N):
+        armed.tick()
+    tick_ns = (time.perf_counter() - t0) / N * 1e9
+    tick_pct = tick_ns / (step_s * 1e9) * 100.0
+    if tick_pct >= 1.0:
+        n_errors += 1
+        print(f"    FAILED: armed duty-cycle overhead {tick_pct:.3f}% of "
+              f"the {step_s * 1e3:.1f}ms step (budget < 1%)")
+    else:
+        print(f"    overhead OK: {tick_ns:.0f}ns/step armed = "
+              f"{tick_pct:.4f}% of the {step_s * 1e3:.1f}ms step (< 1%)")
+
+    monitor.shutdown_roofline()
+    monitor.shutdown_ops()
+
+    # The committed per-op series must gate (single round: absolute
+    # invariants — >= 10 schema-valid rows with per-op gate keys).
+    n_errors += _bench_history_gate("ROOFLINE_r*.json", min_rounds=1)
+
+    print(f"\nlint_traces --roofline: {n_errors} error(s)")
+    return n_errors
+
+
 def _chaos_multihost_smoke() -> int:
     """--chaos-multihost: re-exec this script on a virtual 8-device CPU mesh
     (the device-count flag must be set before jax initializes) and run
@@ -1760,7 +1909,7 @@ def _chaos_multihost_inner() -> int:
 
 _USAGE = ("usage: lint_traces.py [pattern] | --static | --schedule | --chaos | "
           "--chaos-multihost | --multichip | --soak | --federation | --hlo | "
-          "--events <log.jsonl> [...] [--storm-threshold N]")
+          "--roofline | --events <log.jsonl> [...] [--storm-threshold N]")
 
 
 def main(argv=None) -> int:
@@ -1793,6 +1942,9 @@ def main(argv=None) -> int:
 
     if "--ops" in argv:
         return 1 if _ops_smoke() else 0
+
+    if "--roofline" in argv:
+        return 1 if _roofline_smoke() else 0
 
     if "--chaos" in argv:
         return 1 if _chaos_smoke() else 0
@@ -1865,6 +2017,7 @@ def main(argv=None) -> int:
         n_errors += _bench_history_gate("MULTICHIP_BENCH_r*.json")
         n_errors += _bench_history_gate("SOAK_r*.json")
         n_errors += _bench_history_gate("SOAK_POD_r*.json", min_rounds=1)
+        n_errors += _bench_history_gate("ROOFLINE_r*.json", min_rounds=1)
 
     print(f"\nlint_traces: {n_errors} error(s), {n_warnings} warning(s)")
     return 1 if n_errors else 0

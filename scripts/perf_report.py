@@ -60,7 +60,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # time suffix), then lower-is-better time/count shapes. Unmatched metrics are
 # reported in the trajectory but never gated.
 _HIGHER_SUBSTRINGS = ("mfu", "vs_baseline", "tokens_per_sec", "dots_passed",
-                      "goodput")
+                      "goodput", "achieved_frac", "coverage_pct")
 _LOWER_SUFFIXES = ("_s", "_us", "_ms", "_pct", "_pct_static", "_seconds", "_ms_per_step")
 _LOWER_EXACT = {"value", "recompile_count"}
 
@@ -137,6 +137,21 @@ _SOAK_POD_NOISE_FLOORS = (
 )
 
 
+# ROOFLINE_r* rounds (headline metric "roofline_*", from bench.py's
+# roofline path — ISSUE 19): the per-op ``op_<line>_<sym>_us`` /
+# ``_achieved_frac`` series. Per-op microsecond timings are the noisiest
+# numbers the gate sees (single-op, single-probe, tens of µs on the CPU
+# round) — the floors absorb scheduler jitter while still catching an op
+# that genuinely doubled; achieved fraction is a ratio of the same
+# measurement, floored absolutely.
+_ROOFLINE_NOISE_FLOORS = (
+    ("achieved_frac", 0.05),
+    ("_us", 40.0),
+    ("coverage_pct", 10.0),
+    ("value", 0.2),                # total device-busy ms/step
+)
+
+
 def metric_direction(name: str, series: str = "") -> Optional[int]:
     """+1 = higher is better, -1 = lower is better, None = not gated.
     ``series`` (the round's headline ``metric`` name) resolves the fields
@@ -179,6 +194,10 @@ def noise_floor(name: str, series: str = "") -> float:
                 return floor
     if series.lower().startswith("soak"):
         for suffix, floor in _SOAK_NOISE_FLOORS:
+            if low.endswith(suffix):
+                return floor
+    if series.lower().startswith("roofline"):
+        for suffix, floor in _ROOFLINE_NOISE_FLOORS:
             if low.endswith(suffix):
                 return floor
     for suffix, floor in _NOISE_FLOORS:
@@ -371,7 +390,8 @@ def run_history_gate(
         # pass/fail proofs must hold from r01 onward.
         print("perf_report --history: need at least two rounds with metrics "
               "to diff; checking absolute invariants only", file=out)
-        failures = _ops_plane_failures(rounds[-1]) + _pod_failures(rounds[-1])
+        failures = (_ops_plane_failures(rounds[-1]) + _pod_failures(rounds[-1])
+                    + _roofline_failures(rounds[-1]))
         if failures:
             print("\nperf_report: acceptance failed on the newest round: "
                   + ", ".join(failures), file=out)
@@ -390,10 +410,11 @@ def run_history_gate(
             f"{os.path.basename(ack_path or 'BENCH_ACK.json')}",
             file=out,
         )
-    ops_failures = _ops_plane_failures(rounds[-1]) + _pod_failures(rounds[-1])
+    ops_failures = (_ops_plane_failures(rounds[-1]) + _pod_failures(rounds[-1])
+                    + _roofline_failures(rounds[-1]))
     if ops_failures:
         print(
-            "\nperf_report: ops-plane acceptance failed on the newest soak "
+            "\nperf_report: acceptance failed on the newest "
             "round: " + ", ".join(ops_failures), file=out,
         )
     return 1 if (gate and (fresh or ops_failures)) else 0
@@ -476,6 +497,33 @@ def _pod_failures(newest: tuple) -> list[str]:
             not m.get("soak_pod_slice_spread_anomalies"):
         out.append(f"{label}: slow slice injected but no slice_spread "
                    f"anomaly was raised")
+    return out
+
+
+def _roofline_failures(newest: tuple) -> list[str]:
+    """Absolute checks on the newest ROOFLINE round (ISSUE 19) — the
+    committed per-op series must stay a usable baseline regardless of how
+    many rounds exist: at least 10 per-op rows, every row in the
+    observability/roofline.py ``ROW_FIELDS`` schema (bench stamps
+    ``roofline_schema_ok``), and at least 10 flattened
+    ``op_*_achieved_frac`` keys so the per-op direction gate has ops to
+    hold onto."""
+    label, m = newest
+    if not str(m.get("_metric_name", "")).startswith("roofline"):
+        return []
+    out = []
+    rows = m.get("roofline_rows", 0)
+    if rows < 10:
+        out.append(f"{label}: roofline_rows={rows:g} (need >= 10 per-op rows)")
+    if not m.get("roofline_schema_ok"):
+        out.append(f"{label}: roofline_schema_ok="
+                   f"{m.get('roofline_schema_ok', 0):g} (rows violate the "
+                   f"ledger ROW_FIELDS schema)")
+    n_flat = sum(1 for k in m
+                 if k.startswith("op_") and k.endswith("_achieved_frac"))
+    if n_flat < 10:
+        out.append(f"{label}: only {n_flat} flattened op_*_achieved_frac "
+                   f"key(s) (need >= 10 for the per-op gate)")
     return out
 
 

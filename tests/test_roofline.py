@@ -1,0 +1,458 @@
+"""Continuous roofline ledger tests (ISSUE 19): the cost×measured join's
+per-op bytes, ledger fold semantics (achieved fraction, bound class,
+bounded eviction, trend over probe history, committed row schema), the
+two-sided drift band (trip + cooldown with a fake clock, executor-claimed
+ops classifying as kernel_regression), the sampler's duty cycle and its
+probe pipeline on a synthetic CPU trace-event fixture (no profiler plugin
+required), the profile-degraded satellite, and the ROOFLINE series'
+perf_report gate.
+"""
+
+import json
+import os
+import sys
+import types
+from collections import deque
+
+import numpy as np
+import pytest
+
+import thunder_tpu.clang as clang
+import thunder_tpu.monitor as monitor
+from thunder_tpu.analysis.cost import trace_cost
+from thunder_tpu.observability import detect as detect_mod
+from thunder_tpu.observability import events as obs_events
+from thunder_tpu.observability import metrics as obsm
+from thunder_tpu.observability.attribution import (
+    Attribution,
+    ScopeRef,
+    join_cost_attribution,
+)
+from thunder_tpu.observability.detect import (
+    BandDetector,
+    DetectorBank,
+    DetectorConfig,
+)
+from thunder_tpu.observability.roofline import (
+    ROW_FIELDS,
+    RooflineLedger,
+    RooflineSampler,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+
+from perf_report import (  # noqa: E402
+    _roofline_failures,
+    metric_direction,
+    noise_floor,
+)
+
+
+@pytest.fixture(autouse=True)
+def _metrics_isolation():
+    was = monitor.enabled()
+    monitor.disable()
+    monitor.reset()
+    yield
+    monitor.reset()
+    (monitor.enable if was else monitor.disable)()
+
+
+def _extrace(fn, *args):
+    from thunder_tpu.api import trace_program
+    from thunder_tpu.executors.passes import transform_for_execution
+    from thunder_tpu.extend import resolve_executors
+    from thunder_tpu.transforms.common import cse, dce
+
+    _, comp = trace_program(fn, args, {})
+    return transform_for_execution(cse(dce(comp)), resolve_executors(["jax"]))
+
+
+def _matmul_join(measured_us=300.0, steps=1):
+    """A real cost×measured join over a tiny matmul extrace: one measured
+    line matched to its cost row."""
+    a = np.ones((64, 64), np.float32)
+    extrace = _extrace(lambda a, b: clang.sum(clang.tanh(clang.matmul(a, b))), a, a)
+    cost = trace_cost(extrace, "v5e")
+    mm = [r for r in cost.rows if r.kind == "matmul"][0]
+    attr = Attribution(
+        by_line={ScopeRef(mm.index, mm.sym, "Transform_for_execution"): measured_us},
+        device_busy_us=measured_us,
+    )
+    return join_cost_attribution(attr, cost, steps=steps), mm
+
+
+def _fake_join(rows):
+    """A PerfJoin stand-in for pure ledger tests: only `.rows` is folded."""
+    return types.SimpleNamespace(rows=rows)
+
+
+def _fake_row(label, sym="matmul", line=3, measured_us=100.0, share=0.5,
+              roofline_us=40.0, flops=1e6, bytes_moved=2e4, bound="compute"):
+    eff = min(1.0, roofline_us / measured_us) if roofline_us else None
+    return types.SimpleNamespace(
+        label=label, sym=sym, line=line, pass_name="p",
+        measured_us=measured_us, share=share, roofline_us=roofline_us,
+        efficiency=eff, bound=bound, flops=flops, bytes_moved=bytes_moved)
+
+
+# =============================================================================
+# Join carries per-op bytes (the ledger's `bytes` column)
+# =============================================================================
+
+
+class TestJoinBytes:
+    def test_joined_row_carries_cost_bytes(self):
+        join, mm = _matmul_join()
+        row = join.rows[0]
+        assert row.bytes_moved == pytest.approx(mm.bytes_moved)
+        assert row.bytes_moved > 0
+        assert row.flops == pytest.approx(mm.flops)
+        assert 0 < row.efficiency <= 1.0
+
+
+# =============================================================================
+# Ledger fold semantics
+# =============================================================================
+
+
+class TestLedger:
+    def test_fold_real_join_row_schema(self):
+        join, mm = _matmul_join()
+        ledger = RooflineLedger()
+        touched = ledger.fold(join, executor_by_sym={mm.sym: "jax"})
+        assert len(touched) == 1 and ledger.folds == 1
+        snap = ledger.snapshot()
+        row = snap["rows"][0]
+        assert set(row) == set(ROW_FIELDS)
+        assert row["measured_us"] == pytest.approx(300.0)
+        assert row["bytes"] == pytest.approx(mm.bytes_moved)
+        assert row["roofline_us"] == pytest.approx(mm.roofline_s * 1e6, rel=1e-3)
+        assert row["bound"] == mm.bound
+        assert row["executor"] == "jax"
+        assert 0 < row["achieved_frac"] <= 1.0
+        assert snap["schema"] == list(ROW_FIELDS)
+
+    def test_rows_sorted_and_samples_accumulate(self):
+        ledger = RooflineLedger()
+        ledger.fold(_fake_join([_fake_row("a", measured_us=10.0),
+                                _fake_row("b", measured_us=90.0)]))
+        ledger.fold(_fake_join([_fake_row("a", measured_us=12.0)]))
+        rows = ledger.rows()
+        assert [e.label for e in rows] == ["b", "a"]
+        by = {e.label: e for e in rows}
+        assert by["a"].samples == 2 and by["b"].samples == 1
+        assert by["a"].measured_us == pytest.approx(12.0)
+
+    def test_bounded_eviction_drops_cheapest(self):
+        ledger = RooflineLedger(max_ops=3)
+        ledger.fold(_fake_join([
+            _fake_row(f"op{i}", measured_us=float(i + 1)) for i in range(5)
+        ]))
+        labels = {e.label for e in ledger.rows()}
+        assert labels == {"op4", "op3", "op2"}  # op0/op1 (cheapest) evicted
+        assert len(ledger) == 3
+
+    def test_trend_classification(self):
+        ledger = RooflineLedger()
+        for eff in (0.2, 0.2, 0.2, 0.6, 0.6, 0.6):
+            ledger.fold(_fake_join([_fake_row(
+                "up", measured_us=100.0, roofline_us=eff * 100.0)]))
+        for eff in (0.6, 0.6, 0.6, 0.2, 0.2, 0.2):
+            ledger.fold(_fake_join([_fake_row(
+                "down", measured_us=100.0, roofline_us=eff * 100.0)]))
+        for eff in (0.4, 0.41, 0.4, 0.41, 0.4, 0.41):
+            ledger.fold(_fake_join([_fake_row(
+                "steady", measured_us=100.0, roofline_us=eff * 100.0)]))
+        by = {e.label: e for e in ledger.rows()}
+        assert by["up"].trend == "improving"
+        assert by["down"].trend == "degrading"
+        assert by["steady"].trend == "flat"
+        # Fewer than 4 samples: no verdict yet.
+        ledger.fold(_fake_join([_fake_row("young")]))
+        assert {e.label: e for e in ledger.rows()}["young"].trend == "flat"
+
+    def test_format_table(self):
+        ledger = RooflineLedger()
+        ledger.fold(_fake_join([_fake_row("L3.matmul#p")]))
+        out = ledger.format()
+        assert "roofline ledger: 1 op(s)" in out
+        assert "L3.matmul#p" in out and "compute" in out
+
+
+# =============================================================================
+# Drift band: trip, cooldown, classification (fake clock)
+# =============================================================================
+
+
+class TestBandDetector:
+    def test_two_sided_trip_and_cooldown(self):
+        det = BandDetector(factor=1.5, consecutive=2, min_samples=3,
+                           cooldown=4)
+        for _ in range(5):
+            assert det.update(1.0) is None  # baseline learns in-band
+        assert det.update(3.0) is None      # 1st out-of-band hit
+        hit = det.update(3.0)               # 2nd consecutive -> fire
+        assert hit is not None
+        assert hit["ratio"] == pytest.approx(3.0, rel=0.05)
+        # Cooldown: the next `cooldown` out-of-band samples stay quiet...
+        for _ in range(4):
+            assert det.update(3.0) is None
+        # ...then two more consecutive hits re-fire.
+        assert det.update(3.0) is None
+        assert det.update(3.0) is not None
+        # Two-sided: a ratio far BELOW baseline also walks out of the band.
+        low = BandDetector(factor=1.5, consecutive=2, min_samples=3)
+        for _ in range(5):
+            low.update(1.0)
+        low.update(0.2)
+        assert low.update(0.2) is not None
+
+    def test_in_band_resets_consecutive_and_teaches_baseline(self):
+        det = BandDetector(factor=1.5, consecutive=2, min_samples=3)
+        for _ in range(5):
+            det.update(1.0)
+        assert det.update(3.0) is None
+        assert det.update(1.0) is None  # back in band: hits reset
+        assert det.update(3.0) is None  # needs 2 consecutive again
+
+    def test_bank_note_roofline_op_fake_clock(self, monkeypatch):
+        now = [1000.0]
+        monkeypatch.setattr(detect_mod.time, "time", lambda: now[0])
+        bank = DetectorBank(DetectorConfig())
+        # Baseline: three probes at the predicted level (ratio 1.0).
+        for _ in range(3):
+            bank.note_roofline_op("L3.matmul#p", 100.0, 100.0)
+        assert not bank.anomalies
+        # Mispricing: measured walks to 8x predicted for two probes.
+        now[0] = 1010.0
+        bank.note_roofline_op("L3.matmul#p", 800.0, 100.0)
+        bank.note_roofline_op("L3.matmul#p", 800.0, 100.0)
+        assert len(bank.anomalies) == 1
+        a = bank.anomalies[0]
+        assert a.kind == "cost_model_drift"
+        assert a.fn == "L3.matmul#p"
+        assert a.ts == pytest.approx(1010.0)
+        assert a.severity == "critical"  # 8x >= critical_factor 4x
+        # Cooldown: the drift persists but one trip = one anomaly until
+        # the detector re-arms (cooldown samples later).
+        for _ in range(bank.config.cooldown):
+            bank.note_roofline_op("L3.matmul#p", 800.0, 100.0)
+        assert len(bank.anomalies) == 1
+        assert bank.debug_state()["roofline_streams"] == 1
+
+    def test_executor_claimed_op_is_kernel_regression(self, monkeypatch):
+        monkeypatch.setattr(detect_mod.time, "time", lambda: 5.0)
+        bank = DetectorBank(DetectorConfig())
+        for _ in range(3):
+            bank.note_roofline_op("L7.sdpa#p", 50.0, 50.0, executor="flash")
+        bank.note_roofline_op("L7.sdpa#p", 400.0, 50.0, executor="flash")
+        bank.note_roofline_op("L7.sdpa#p", 400.0, 50.0, executor="flash")
+        assert [a.kind for a in bank.anomalies] == ["kernel_regression"]
+
+    def test_nonpositive_inputs_ignored(self):
+        bank = DetectorBank(DetectorConfig())
+        bank.note_roofline_op("x", 0.0, 10.0)
+        bank.note_roofline_op("x", 10.0, 0.0)
+        bank.note_roofline_op("x", None, 10.0)
+        assert bank.debug_state()["roofline_streams"] == 0
+
+
+# =============================================================================
+# Sampler: duty cycle + probe pipeline on a synthetic trace fixture
+# =============================================================================
+
+
+def _synthetic_trace(trace_dir, rows):
+    """Write a minimal Chrome-trace file attribute() can parse: one device
+    metadata record + one complete event per (scope, dur_us) row."""
+    events = [{"ph": "M", "pid": 1, "name": "process_name",
+               "args": {"name": "/device:TPU:0"}}]
+    ts = 0.0
+    for name, dur in rows:
+        events.append({"ph": "X", "pid": 1, "tid": 1, "ts": ts, "dur": dur,
+                       "name": name})
+        ts += dur
+    path = os.path.join(trace_dir, "host.trace.json")
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+class TestSampler:
+    def test_duty_cycle_counts(self, monkeypatch):
+        probed = []
+        sampler = RooflineSampler(every=3)
+        monkeypatch.setattr(
+            sampler, "sample",
+            lambda fn, *a, **k: probed.append(1) or fn(*a, **k))
+        calls = []
+        out = None
+        for i in range(9):
+            out = sampler.maybe_sample(lambda: calls.append(i) or i)
+        assert len(calls) == 9 and out == 8  # fn runs (and returns) every step
+        assert len(probed) == 3              # steps 3, 6, 9
+
+    def test_off_by_default_and_env_arming(self, monkeypatch):
+        monkeypatch.delenv("THUNDER_TPU_ROOFLINE_EVERY", raising=False)
+        off = RooflineSampler()
+        assert off.every == 0 and not off.enabled
+        for _ in range(5):
+            off.maybe_sample(lambda: 1)
+        assert off.probes == 0 and not off.tick()
+        monkeypatch.setenv("THUNDER_TPU_ROOFLINE_EVERY", "5")
+        assert RooflineSampler().every == 5
+        monkeypatch.setenv("THUNDER_TPU_ROOFLINE_EVERY", "bogus")
+        assert RooflineSampler().every == 0
+
+    def test_probe_pipeline_on_synthetic_fixture(self, monkeypatch, tmp_path):
+        """A full probe against a synthetic CPU trace-event fixture: no
+        profiler plugin — the profile bracket is stubbed to drop a
+        pre-built trace file, and the cost half is a real trace_cost of
+        the same extrace the scopes name."""
+        a = np.ones((64, 64), np.float32)
+        extrace = _extrace(
+            lambda a, b: clang.sum(clang.tanh(clang.matmul(a, b))), a, a)
+        cost = trace_cost(extrace, "v5e")
+        mm = [r for r in cost.rows if r.kind == "matmul"][0]
+        scope = f"jit_f/L{mm.index}.{mm.sym}#Transform_for_execution"
+
+        import thunder_tpu.observability.profile as profile_mod
+
+        def fake_profile(fn, *args, trace_dir=None, **kwargs):
+            fn(*args)
+            _synthetic_trace(trace_dir, [(scope, 120.0)])
+            return {"trace_dir": trace_dir, "steps": 1, "total_s": 1e-4,
+                    "avg_s": 1e-4, "profiler": True, "attribution": None}
+
+        monkeypatch.setattr(profile_mod, "profile", fake_profile)
+        bank = DetectorBank(DetectorConfig())
+        sampler = RooflineSampler(every=1, bank=bank)
+        sampler._cost = cost
+        sampler._executor_by_sym = {mm.sym: "jax"}
+        sampler._resolved = True
+        out = sampler.maybe_sample(lambda: "step-out")
+        assert out == "step-out"
+        assert sampler.probes == 1
+        entry = sampler.ledger.rows()[0]
+        assert entry.sym == mm.sym and entry.line == mm.index
+        assert entry.measured_us == pytest.approx(120.0)
+        assert entry.roofline_us == pytest.approx(mm.roofline_s * 1e6, rel=1e-3)
+        assert entry.bytes == pytest.approx(mm.bytes_moved)
+        assert entry.executor == "jax"
+        assert sampler.last_coverage == pytest.approx(1.0)
+        # The probe streamed the op's ratio into the bank.
+        assert bank.debug_state()["roofline_streams"] == 1
+        state = sampler.debug_state()
+        assert state["enabled"] and state["probes"] == 1
+        assert state["ledger"]["ops"] == 1
+
+
+# =============================================================================
+# Profile-degraded satellite
+# =============================================================================
+
+
+class TestProfileDegraded:
+    def test_missing_plugin_counts_and_emits(self, monkeypatch, tmp_path):
+        import jax
+
+        import thunder_tpu as ttpu
+
+        def boom(*a, **k):
+            raise RuntimeError("no profiler plugin")
+
+        monkeypatch.setattr(jax.profiler, "trace", boom)
+        seen = []
+        obs_events.set_ops_taps((lambda kind, fields: seen.append((kind, fields)),))
+        try:
+            before = obsm.PROFILE_CAPTURES.value(ok="false")
+            with pytest.warns(UserWarning, match="profiler unavailable"):
+                res = ttpu.profile(lambda: 1, trace_dir=str(tmp_path),
+                                   steps=1, warmup=0)
+        finally:
+            obs_events.set_ops_taps(())
+        assert res["profiler"] is False and res["trace_dir"] is None
+        assert obsm.PROFILE_CAPTURES.value(ok="false") == before + 1
+        degraded = [f for k, f in seen if k == "profile_degraded"]
+        assert degraded and "no profiler plugin" in degraded[0]["reason"]
+
+    def test_ok_capture_counts_true(self, monkeypatch, tmp_path):
+        import contextlib
+
+        import jax
+
+        import thunder_tpu as ttpu
+
+        monkeypatch.setattr(jax.profiler, "trace",
+                            lambda d: contextlib.nullcontext())
+        before = obsm.PROFILE_CAPTURES.value(ok="true")
+        res = ttpu.profile(lambda: 1, trace_dir=str(tmp_path), steps=1,
+                           warmup=0)
+        assert res["profiler"] is True
+        assert obsm.PROFILE_CAPTURES.value(ok="true") == before + 1
+
+    def test_healthz_profile_component_degrades(self, monkeypatch, tmp_path):
+        import jax
+
+        import thunder_tpu as ttpu
+
+        health = monitor.ops_health()
+        assert health["components"]["profile"]["status"] == "ok"
+        monkeypatch.setattr(jax.profiler, "trace",
+                            lambda d: (_ for _ in ()).throw(RuntimeError("x")))
+        with pytest.warns(UserWarning):
+            ttpu.profile(lambda: 1, trace_dir=str(tmp_path), steps=1, warmup=0)
+        health = monitor.ops_health()
+        assert health["components"]["profile"]["status"] == "degraded"
+
+
+# =============================================================================
+# ROOFLINE series gate (perf_report)
+# =============================================================================
+
+
+def _roofline_round(n_rows=12, schema_ok=1):
+    m = {"_metric_name": "roofline_gpt_tiny_fwd", "value": 0.5,
+         "roofline_rows": n_rows, "roofline_schema_ok": schema_ok}
+    for i in range(n_rows):
+        m[f"op_L{i}_matmul_us"] = 10.0 + i
+        m[f"op_L{i}_matmul_achieved_frac"] = 0.5
+    return ("r01", m)
+
+
+class TestRooflineGate:
+    def test_direction_and_floors(self):
+        assert metric_direction("op_L3_matmul_achieved_frac") == 1
+        assert metric_direction("op_L3_matmul_us") == -1
+        assert metric_direction("roofline_coverage_pct") == 1
+        assert noise_floor("op_L3_matmul_us", "roofline_gpt_tiny_fwd") == 40.0
+        assert noise_floor("op_L3_matmul_achieved_frac",
+                           "roofline_gpt_tiny_fwd") == 0.05
+        # The roofline floors are series-scoped: the single-host bench's
+        # microsecond metrics keep their own (tighter) floors.
+        assert noise_floor("trace_cache_lookup_us",
+                           "open_llama_3b_train_iter_b2_t2048") == 5.0
+
+    def test_absolute_invariants(self):
+        assert _roofline_failures(_roofline_round()) == []
+        fails = _roofline_failures(_roofline_round(n_rows=4))
+        assert any("roofline_rows=4" in f for f in fails)
+        fails = _roofline_failures(_roofline_round(schema_ok=0))
+        assert any("roofline_schema_ok" in f for f in fails)
+        # Non-roofline series are exempt.
+        assert _roofline_failures(("r01", {"_metric_name": "soak_goodput"})) == []
+
+    def test_committed_round_passes(self):
+        from perf_report import load_round, run_history_gate
+
+        path = os.path.join(REPO_ROOT, "ROOFLINE_r01.json")
+        assert os.path.exists(path), "ROOFLINE_r01.json must be committed"
+        label, m = load_round(path)
+        assert m["roofline_rows"] >= 10
+        assert _roofline_failures((label, m)) == []
+        doc = json.load(open(path))
+        assert len(doc["rows"]) >= 10
+        for row in doc["rows"]:
+            assert set(row) == set(ROW_FIELDS)

@@ -88,6 +88,15 @@ SCHEMA: dict[str, frozenset] = {
     # that died mid-flush.
     "slice_state": frozenset({"slice", "from", "to", "reason"}),
     "ckpt_tmp_sweep": frozenset({"count"}),
+    # Continuous roofline ledger (ISSUE 19; docs/observability.md
+    # "roofline"): one record per duty-cycled probe (how many ledger ops
+    # the join touched, what the probe cost), and the profiler bracket's
+    # degradation marker — the plugin was missing, so the capture (and
+    # every roofline probe behind it) is wall-clock only. Per-op drift
+    # verdicts ride the existing `anomaly` kind (anomaly=cost_model_drift
+    # | kernel_regression), not a new one.
+    "roofline_probe": frozenset({"step", "ops", "probe_s"}),
+    "profile_degraded": frozenset({"reason"}),
 }
 _COMMON = frozenset({"v", "ts", "seq", "kind"})
 

@@ -190,3 +190,43 @@ def attribution_report(
     attr = attribute(trace_dir, hlo_text=hlo_text)
     join = join_cost_attribution(attr, cost, steps=steps)
     return join
+
+
+def roofline(jfn=None, *, every: Optional[int] = None, **options):
+    """Arm the continuous roofline ledger (ISSUE 19): install a
+    process-wide duty-cycled sampler that, every ``every`` steps, runs one
+    step under the profiler bracket, joins measured per-op time with the
+    static cost model, folds the result into the bounded per-op ledger,
+    and streams measured/predicted ratios into the ops-plane drift
+    detectors (``cost_model_drift`` / ``kernel_regression`` anomalies).
+
+    Wrap the step with the returned sampler::
+
+        sampler = monitor.roofline(jfn, every=200)
+        for batch in data:
+            loss = sampler.maybe_sample(jfn, params, batch)
+
+    ``every=None`` reads ``THUNDER_TPU_ROOFLINE_EVERY`` (unset/0 = never
+    probes — the off-path cost is one counter bump). Live ledger:
+    ``/debug/roofline`` when the ops plane serves, or
+    :func:`roofline_report`; ``options`` forward to
+    ``observability.roofline.enable`` (device, hlo_text, ledger, ...)."""
+    from thunder_tpu.observability import roofline as roofline_mod
+
+    return roofline_mod.enable(jfn, every=every, **options)
+
+
+def roofline_report(top_k: int = 10) -> Optional[str]:
+    """The live roofline ledger as a printable table (None when no sampler
+    is installed) — the in-process spelling of ``/debug/roofline``."""
+    from thunder_tpu.observability import roofline as roofline_mod
+
+    sampler = roofline_mod.current()
+    return sampler.ledger.format(top_k) if sampler is not None else None
+
+
+def shutdown_roofline() -> None:
+    """Uninstall the process-wide roofline sampler."""
+    from thunder_tpu.observability import roofline as roofline_mod
+
+    roofline_mod.disable()

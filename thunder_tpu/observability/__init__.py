@@ -24,6 +24,12 @@ CompileStats/last_traces/TraceProvenance + profile.py NVTX markers):
   (``L<idx>.<sym>#<pass>`` scopes), joinable with the static cost model
   (``thunder_tpu/analysis/cost.py``) into the roofline/MFU report exposed
   as ``thunder_tpu.monitor.attribution_report()``.
+- :mod:`~thunder_tpu.observability.roofline` — the continuous spelling of
+  the above (ISSUE 19): a duty-cycled in-loop sampler folding probe joins
+  into a bounded per-op ledger (``/debug/roofline``,
+  ``monitor.roofline_report()``), with per-op measured/predicted drift
+  streamed into the detector bank as ``cost_model_drift`` /
+  ``kernel_regression`` anomalies.
 
 Import structure: ``metrics`` and ``events`` are stdlib-only (safe to import
 from ``core/trace.py`` and ``common.py`` without cycles); ``instrument`` and
@@ -55,6 +61,10 @@ _LAZY = {
     "parse_scope": "thunder_tpu.observability.attribution",
     "hlo_scope_map": "thunder_tpu.observability.attribution",
     "join_cost_attribution": "thunder_tpu.observability.attribution",
+    "RooflineSampler": "thunder_tpu.observability.roofline",
+    "RooflineLedger": "thunder_tpu.observability.roofline",
+    "RooflineEntry": "thunder_tpu.observability.roofline",
+    "BandDetector": "thunder_tpu.observability.detect",
 }
 
 

@@ -588,6 +588,7 @@ class JoinedRow:
     efficiency: Optional[float] = None  # roofline/measured, 1.0 = at the roof
     bound: Optional[str] = None  # compute|memory|comm|free
     flops: Optional[float] = None
+    bytes_moved: Optional[float] = None
 
 
 @dataclass
@@ -759,6 +760,7 @@ def join_cost_attribution(
             row.roofline_us = crow.roofline_s * 1e6
             row.bound = crow.bound
             row.flops = crow.flops
+            row.bytes_moved = crow.bytes_moved
             if measured > 0:
                 row.efficiency = min(1.0, row.roofline_us / measured)
         rows.append(row)

@@ -209,6 +209,60 @@ class DriftDetector:
         }
 
 
+class BandDetector:
+    """Two-sided ratio-band detector for slow-cadence streams (the roofline
+    duty cycle feeds one sample per probe, not per step): a slow EWMA
+    baseline; fires when ``value/baseline`` leaves ``[1/factor, factor]``
+    for ``consecutive`` samples. Two-sided because both directions are
+    verdicts — an op running slower than its history is a kernel
+    regression, an op running *faster* than the cost model ever predicted
+    means the pricing is stale. Only in-band samples teach the baseline,
+    and a fired detector stays quiet for ``cooldown`` samples (sample
+    count, not wall clock: at one probe every N steps a time-based
+    cooldown would never be reached)."""
+
+    __slots__ = ("slow", "factor", "consecutive", "min_samples",
+                 "cooldown", "_hits", "_quiet", "window")
+
+    def __init__(self, *, slow_alpha: float = 0.2, factor: float = 1.5,
+                 consecutive: int = 2, min_samples: int = 3,
+                 cooldown: int = 16, window: int = 8):
+        self.slow = EwmaStat(slow_alpha)
+        self.factor = float(factor)
+        self.consecutive = int(consecutive)
+        self.min_samples = int(min_samples)
+        self.cooldown = int(cooldown)
+        self._hits = 0
+        self._quiet = 0
+        self.window: deque = deque(maxlen=int(window))
+
+    def update(self, x: float) -> Optional[dict]:
+        x = float(x)
+        self.window.append(x)
+        if self.slow.n < self.min_samples:
+            self.slow.update(x)
+            return None
+        ratio = x / self.slow.mean if self.slow.mean else 0.0
+        if ratio > 0 and (1.0 / self.factor) <= ratio <= self.factor:
+            self.slow.update(x)
+            self._hits = 0
+            return None
+        if self._quiet > 0:
+            self._quiet -= 1
+            return None
+        self._hits += 1
+        if self._hits < self.consecutive:
+            return None
+        self._hits = 0
+        self._quiet = self.cooldown
+        return {
+            "value": x,
+            "baseline": self.slow.mean,
+            "ratio": round(ratio, 3),
+            "window": [round(v, 6) for v in self.window],
+        }
+
+
 class RateDetector:
     """Events-per-window threshold (the recompile-storm shape): ``tick(ts)``
     fires when ``threshold`` ticks land inside ``window_s``. The tick
@@ -315,6 +369,12 @@ class DetectorConfig:
     # Cross-slice (DCN-tier) spread: lower bar than the in-slice host
     # spread — a whole slice lagging is a federation-level event (ISSUE 18).
     slice_spread_threshold: float = 1.3
+    # Roofline duty-cycle streams (ISSUE 19): per-op measured/predicted
+    # ratio band. Sized for *rare* samples — one per duty-cycled probe —
+    # so the trip thresholds are much lower than the per-step detectors'.
+    roofline_band_factor: float = 1.5
+    roofline_consecutive: int = 2
+    roofline_min_samples: int = 3
     # Samples a tripped detector stays quiet before re-arming (one drift =
     # one anomaly, then periodic re-alerts while it persists).
     cooldown: int = 16
@@ -384,6 +444,7 @@ class DetectorBank:
         self._slice_acc = HostHealthAccumulator()
         self._slice_hits = 0
         self._slice_quiet = 0
+        self._roofline: dict[str, BandDetector] = {}
         self.anomalies: deque = deque(maxlen=self.config.max_anomalies)
         self.consumed = 0
 
@@ -517,6 +578,44 @@ class DetectorBank:
         for a in raised:
             self._publish(a)
 
+    def note_roofline_op(self, label: str, measured_us: float,
+                         roofline_us: float, *,
+                         executor: Optional[str] = None) -> None:
+        """Direct per-op feed from the roofline sampler (ISSUE 19): each
+        duty-cycled probe reports every ledger op's measured device time
+        against its static roofline bound. The measured/predicted ratio
+        streams into a per-op :class:`BandDetector`; a sustained walk out
+        of the band is ``kernel_regression`` when an executor claimed the
+        op (a regressed Pallas/custom kernel) and ``cost_model_drift``
+        otherwise (the pricing no longer describes the hardware). Direct
+        feed, not an event tap: probe joins are already in-process objects
+        and the per-op fanout would be noise on the event log."""
+        try:
+            measured = float(measured_us)
+            predicted = float(roofline_us)
+        except (TypeError, ValueError):
+            return
+        if measured <= 0 or predicted <= 0:
+            return
+        cfg = self.config
+        claimed = executor not in (None, "", "jax")
+        raised: list[Anomaly] = []
+        with self._lock:
+            det = self._roofline.get(label)
+            if det is None:
+                det = self._roofline[label] = BandDetector(
+                    factor=cfg.roofline_band_factor,
+                    consecutive=cfg.roofline_consecutive,
+                    min_samples=cfg.roofline_min_samples,
+                    cooldown=cfg.cooldown,
+                )
+            hit = det.update(measured / predicted)
+            if hit:
+                kind = "kernel_regression" if claimed else "cost_model_drift"
+                raised = [self._anomaly(kind, "roofline_band", hit, fn=label)]
+        for a in raised:
+            self._publish(a)
+
     def _on_recompile(self) -> list:
         hit = self._recompiles.tick()
         if not hit:
@@ -618,6 +717,7 @@ class DetectorBank:
             return {
                 "consumed": self.consumed,
                 "step_streams": sorted(self._step),
+                "roofline_streams": len(self._roofline),
                 "slices": len(self._slice_acc),
                 "recompile_window": len(self._recompiles._ticks),
                 "anomalies": [

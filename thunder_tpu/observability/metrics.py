@@ -516,3 +516,24 @@ FLIGHTREC_DUMPS = REGISTRY.counter(
     "Flight-recorder black-box dumps, labelled by trigger reason",
     always=True,
 )
+
+# -- continuous roofline ledger (ISSUE 19) -------------------------------------
+
+# Always-export: ok="false" means the profiler plugin was missing and the
+# bracket silently degraded to wall clock — every duty-cycled roofline
+# probe on that backend measures nothing. /healthz degrades its `profile`
+# component off this counter, so it must be visible with metrics off.
+PROFILE_CAPTURES = REGISTRY.counter(
+    "thunder_tpu_profile_captures_total",
+    "Profiler bracket attempts, labelled ok=true|false (false = plugin "
+    "missing, wall-clock-only capture; see the profile_degraded event)",
+    always=True,
+)
+# Always-export so "zero probes with sampling off" is checkable from the
+# wire, not just from sampler state (lint_traces --roofline asserts both).
+ROOFLINE_PROBES = REGISTRY.counter(
+    "thunder_tpu_roofline_probes_total",
+    "Duty-cycled roofline probes (one profiled step folded into the "
+    "per-op ledger)",
+    always=True,
+)

@@ -223,6 +223,9 @@ def health_verdict(plane: Optional["OpsPlane"] = None, *,
     - ``checkpoint`` — in-flight background flushes; one stuck past
       ``flush_stuck_s`` → degraded (disk durability is stalling);
     - ``quarantine`` — live executor quarantines → degraded;
+    - ``profile`` — profiler bracket captures (ISSUE 19): any capture
+      that degraded to wall clock (missing plugin) → degraded, since
+      every roofline duty-cycle probe behind it measured nothing;
     - ``anomalies`` — detector verdicts within ``anomaly_window_s``:
       any warn → degraded, any critical → critical."""
     plane = plane if plane is not None else current()
@@ -319,6 +322,17 @@ def health_verdict(plane: Optional["OpsPlane"] = None, *,
          {"entries": len(quarantined)},
          f"{len(quarantined)} quarantined (sym, executor) pair(s)")
 
+    # Degraded profiler captures (ISSUE 19): any ok="false" bump means a
+    # profile bracket ran without the plugin — wall-clock-only duty cycles
+    # would otherwise stay invisible until someone read the ledger and
+    # noticed it never grew.
+    degraded_caps = obsm.PROFILE_CAPTURES.value(ok="false")
+    comp("profile", "degraded" if degraded_caps else "ok",
+         {"captures_ok": obsm.PROFILE_CAPTURES.value(ok="true"),
+          "captures_degraded": degraded_caps},
+         f"{degraded_caps} profiler capture(s) degraded to wall clock "
+         "(no profiler plugin)")
+
     recent: list = []
     if plane is not None and plane.bank is not None:
         recent = plane.bank.recent_anomalies(within_s=anomaly_window_s)
@@ -376,6 +390,9 @@ def debug_state(plane: Optional["OpsPlane"] = None) -> dict:
         plane.bank.debug_state()
         if plane is not None and plane.bank is not None else None
     )
+    from thunder_tpu.observability import roofline as roofline_mod
+
+    out["roofline"] = roofline_mod.debug_state()
     return out
 
 
@@ -427,6 +444,13 @@ class OpsServer:
                         self._send(200, json.dumps(debug_state(outer.plane),
                                                    default=str),
                                    "application/json")
+                    elif route == "/debug/roofline":
+                        from thunder_tpu.observability import (
+                            roofline as roofline_mod)
+
+                        self._send(200, json.dumps(
+                            roofline_mod.debug_state(), default=str),
+                            "application/json")
                     elif route == "/debug/flightrec":
                         rec = outer.plane.recorder
                         if rec is None:

@@ -21,6 +21,17 @@ from typing import Any, Callable, Optional
 from thunder_tpu.observability.events import emit_event
 
 
+def _count_capture(*, ok: bool) -> None:
+    """Bump ``thunder_tpu_profile_captures_total{ok=}`` past the metrics
+    gate (always-export; never fails the bracket)."""
+    try:
+        from thunder_tpu.observability import metrics as obsm
+
+        obsm.PROFILE_CAPTURES.inc_always(ok="true" if ok else "false")
+    except Exception:
+        pass
+
+
 def _block_on(out: Any) -> None:
     """Synchronize on every array leaf so the profiled region contains the
     device work, not just its async dispatch."""
@@ -88,6 +99,15 @@ def profile(
             "collecting wall-clock only",
             stacklevel=2,
         )
+        # A degraded capture must be loud beyond the one-shot warning: the
+        # roofline duty cycle (ISSUE 19) calls this bracket unattended, and
+        # a plugin-less backend would silently produce wall-clock-only
+        # probes forever. The always-export counter reaches /metrics and
+        # degrades the /healthz `profile` component; the typed event lands
+        # in the log/flight recorder next to the probes it explains.
+        _count_capture(ok=False)
+        emit_event(
+            "profile_degraded", reason=f"{type(e).__name__}: {e}")
 
     out = None
     t0 = time.perf_counter()
@@ -103,6 +123,8 @@ def profile(
         if profiler_ctx is not None:
             profiler_ctx.__exit__(None, None, None)
     total = time.perf_counter() - t0
+    if profiler_ok:
+        _count_capture(ok=True)
     result = {
         "trace_dir": trace_dir if profiler_ok else None,
         "steps": steps,
