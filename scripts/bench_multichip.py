@@ -311,6 +311,17 @@ def bench_fsdp_tp(args, result: dict) -> None:
             for fam, agg in sorted(hrep.by_family.items())
         }
         result["compile_phases"]["hlo_audit_s"] = round(hrep.audit_s, 3)
+        # Per-tier split of the audited wire (ISSUE 20): collectives whose
+        # group fits inside one model-parallel block are charged to the
+        # ICI tier, wider ones to DCN — the fleet timeline's static input
+        # for its exposed-ICI/exposed-DCN critical-path classes
+        # (observability/timeline.split_static_wire).
+        from thunder_tpu.observability.timeline import split_static_wire
+
+        tier = split_static_wire(hrep.sites, factors["tp"])
+        result["hlo_wire_ici_us_static"] = round(tier["ici_us"], 2)
+        result["hlo_wire_dcn_us_static"] = round(tier["dcn_us"], 2)
+        result["hlo_wire_ici_frac_static"] = round(tier["ici_frac"], 4)
         _log(f"hlo audit: {hrep.n_ops} ops, {len(hrep.sites)} collectives "
              f"({hrep.inserted_collectives} partitioner-inserted), static "
              f"exposed {result['spmd_collective_exposed_pct_static']}% in "

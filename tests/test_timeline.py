@@ -1,0 +1,546 @@
+"""Fleet critical-path ledger tests (ISSUE 20): clock alignment from
+collective rendezvous barriers (constant offsets, drift, an outlier host,
+the min-samples cut), the step decomposition's accounting identities
+(classes sum to wall time, straggler vs the fleet median, proportional
+capping, the 2-host median-halving convention), the bounded ledger's
+EWMA/trend/attribution, the live recorder (skew recovery from emulated
+offsets, DetectorBank feed tripping ``bottleneck_shift`` on a seeded
+straggler and on a dominant-class flip, the critpath re-arm cadence),
+skew-corrected ``merge_event_logs`` ordering, the offline assembly twin,
+the HLO static wire-tier split, the static-vs-measured cross-check, the
+/healthz ``timeline`` component, autopilot citation of ``bottleneck_shift``,
+and the CRITPATH series' perf_report gate.
+"""
+
+import json
+import os
+import sys
+import time
+import types
+
+import pytest
+
+import thunder_tpu.monitor as monitor
+from thunder_tpu.analysis.events import merge_event_logs
+from thunder_tpu.observability import timeline as tl_mod
+from thunder_tpu.observability.detect import DetectorBank, DetectorConfig
+from thunder_tpu.observability.timeline import (
+    CLASSES,
+    CritPathLedger,
+    TimelineRecorder,
+    apply_offsets,
+    decompose_step,
+    estimate_skew,
+    ledger_from_records,
+    offsets_for_merge,
+    split_static_wire,
+)
+from thunder_tpu.resilience.autopilot import Autopilot, Signal
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+
+from perf_report import (  # noqa: E402
+    _critpath_failures,
+    metric_direction,
+    noise_floor,
+)
+
+
+@pytest.fixture(autouse=True)
+def _timeline_isolation():
+    was = monitor.enabled()
+    monitor.disable()
+    monitor.reset()
+    tl_mod.disable()
+    yield
+    tl_mod.disable()
+    monitor.reset()
+    (monitor.enable if was else monitor.disable)()
+
+
+def _barrier_records(offsets, n_barriers, *, base=1_000.0, spacing=1.0,
+                     drift=None):
+    """Synthetic multi-host barrier logs: every host completes rendezvous
+    ``i`` at true time ``base + i*spacing``, stamped on its own (skewed,
+    optionally drifting) clock."""
+    drift = drift or {}
+    records = []
+    for i in range(n_barriers):
+        true_ts = base + i * spacing
+        for host, off in offsets.items():
+            ts = true_ts + off + drift.get(host, 0.0) * (true_ts - base)
+            records.append({"kind": "collective", "fn": "train_step",
+                            "cid": i, "host": host, "ts": ts})
+    return records
+
+
+def _centered(offsets, skip=()):
+    vals = sorted(v for h, v in offsets.items() if h not in skip)
+    mid = len(vals) // 2
+    med = vals[mid] if len(vals) % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+    return {h: v - med for h, v in offsets.items()}
+
+
+# =============================================================================
+# Clock alignment
+# =============================================================================
+
+
+def test_skew_recovery_constant_offsets():
+    injected = {"a": 0.0, "b": 0.12, "c": -0.08, "d": 0.04}
+    ests = estimate_skew(_barrier_records(injected, 10))
+    assert set(ests) == set(injected)
+    want = _centered(injected)
+    for host, est in ests.items():
+        assert abs(est.offset_s - want[host]) < 2e-3, host
+        assert not est.outlier
+        assert est.samples == 10
+        assert est.confidence > 0.9
+        assert est.mad_s < 1e-3
+
+
+def test_skew_recovery_with_drift():
+    # Host b's clock runs fast by 1 ms of skew per second of wall clock on
+    # top of a 100 ms constant offset; the estimator's per-host slope must
+    # recover the drift rate while the non-drifting hosts stay near zero.
+    injected = {"a": 0.0, "b": 0.10, "c": 0.0}
+    ests = estimate_skew(
+        _barrier_records(injected, 12, spacing=2.0, drift={"b": 1e-3})
+    )
+    assert abs(ests["b"].drift_s_per_s - 1e-3) < 3e-4
+    assert abs(ests["a"].drift_s_per_s) < 3e-4
+    assert abs(ests["c"].drift_s_per_s) < 3e-4
+
+
+def test_skew_outlier_host_flagged():
+    # An unstable clock (alternating +-200 ms) has no constant offset; it
+    # must be flagged as an outlier — and excluded from the re-centering —
+    # while the stable hosts keep tight, confident estimates.
+    stable = {"a": 0.0, "b": 0.04, "c": -0.04}
+    records = _barrier_records(stable, 10)
+    for i in range(10):
+        records.append({"kind": "collective", "fn": "train_step", "cid": i,
+                        "host": "noisy",
+                        "ts": 1_000.0 + i + (0.2 if i % 2 else -0.2)})
+    ests = estimate_skew(records)
+    assert ests["noisy"].outlier
+    assert ests["noisy"].mad_s > 0.05
+    for host in stable:
+        assert not ests[host].outlier, host
+        assert ests[host].confidence > ests["noisy"].confidence
+    # Centering used only the non-outlier hosts: their recovered offsets
+    # match the stable-set centering, not one dragged by the wild clock.
+    want = _centered(stable)
+    for host in stable:
+        assert abs(ests[host].offset_s - want[host]) < 0.03, host
+
+
+def test_skew_min_samples_cut():
+    records = _barrier_records({"a": 0.0, "b": 0.05}, 6)
+    # Host "late" shows up for only two rendezvous: below min_samples=3.
+    for i in (4, 5):
+        records.append({"kind": "collective", "fn": "train_step", "cid": i,
+                        "host": "late", "ts": 1_000.0 + i + 0.01})
+    ests = estimate_skew(records)
+    assert "late" not in ests
+    assert set(ests) == {"a", "b"}
+
+
+def test_offsets_for_merge_and_apply():
+    injected = {"a": 0.0, "b": 0.12, "c": -0.08}
+    ests = estimate_skew(_barrier_records(injected, 8))
+    offsets = offsets_for_merge(ests)
+    assert set(offsets) == set(injected)
+    recs = [{"kind": "x", "host": "b", "ts": 10.0},
+            {"kind": "x", "host": "zzz", "ts": 10.0}]
+    shifted = apply_offsets(recs, offsets)
+    assert shifted[0]["ts"] == pytest.approx(10.0 - offsets["b"])
+    assert shifted[1]["ts"] == 10.0  # unknown host untouched
+    assert recs[0]["ts"] == 10.0     # copies, not mutation
+
+
+# =============================================================================
+# Step decomposition
+# =============================================================================
+
+
+def test_decompose_step_accounting_identity():
+    bd = decompose_step(7, {
+        "h0": {"total_s": 1.0},
+        "h1": {"total_s": 1.0},
+        "h2": {"total_s": 1.3, "ici_s": 0.2, "dcn_s": 0.1, "stall_s": 0.05,
+               "compute_s": 0.5},
+    })
+    assert bd.step == 7 and bd.n_hosts == 3 and bd.slowest_host == "h2"
+    assert set(bd.classes) == set(CLASSES)
+    assert sum(bd.classes.values()) == pytest.approx(bd.total_s)
+    assert bd.classes["straggler_wait"] == pytest.approx(0.3)
+    assert bd.classes["exposed_ici"] == pytest.approx(0.2)
+    assert bd.classes["exposed_dcn"] == pytest.approx(0.1)
+    assert bd.classes["stall"] == pytest.approx(0.05)
+    assert bd.classes["compute"] == pytest.approx(0.5)
+    assert bd.classes["idle"] == pytest.approx(0.15)
+    assert sum(bd.fractions().values()) == pytest.approx(1.0)
+
+
+def test_decompose_step_compute_inferred_and_capped():
+    # No measured compute: the unaccounted budget becomes compute, idle 0.
+    bd = decompose_step(0, {
+        "h0": {"total_s": 1.0, "ici_s": 0.1, "dcn_s": 0.05, "stall_s": 0.05},
+        "h1": {"total_s": 1.0},
+    })
+    assert bd.classes["compute"] == pytest.approx(0.8)
+    assert bd.classes["idle"] == 0.0
+    # Typed spans exceeding the median-lane budget are scaled down
+    # proportionally — the accounting identity survives over-reporting.
+    bd = decompose_step(1, {
+        "h0": {"total_s": 1.0, "ici_s": 1.5, "dcn_s": 0.5},
+        "h1": {"total_s": 1.0},
+    })
+    assert sum(bd.classes.values()) == pytest.approx(1.0)
+    assert bd.classes["exposed_ici"] == pytest.approx(0.75)
+    assert bd.classes["exposed_dcn"] == pytest.approx(0.25)
+    assert decompose_step(2, {"h0": {"total_s": 0.0}}) is None
+
+
+def test_decompose_step_two_host_median_halving():
+    # With two hosts the fleet median averages the pair, so only half the
+    # lag counts as straggler-wait (the convention the soak's straggler
+    # band threshold is calibrated against).
+    bd = decompose_step(0, {"fast": {"total_s": 1.0},
+                            "slow": {"total_s": 1.1}})
+    assert bd.slowest_host == "slow"
+    assert bd.classes["straggler_wait"] == pytest.approx(0.05)
+
+
+# =============================================================================
+# Bounded ledger
+# =============================================================================
+
+
+def _bd(step, *, compute=0.8, straggler=0.0, host="h0", total=None):
+    classes = {"compute": compute, "exposed_ici": 0.1, "exposed_dcn": 0.05,
+               "straggler_wait": straggler, "stall": 0.03, "idle": 0.02}
+    from thunder_tpu.observability.timeline import StepBreakdown
+
+    return StepBreakdown(step=step, total_s=total or sum(classes.values()),
+                         classes=classes, slowest_host=host, n_hosts=4)
+
+
+def test_ledger_fold_trend_and_attribution():
+    ledger = CritPathLedger(capacity=4, alpha=0.3)
+    for i in range(6):
+        ledger.fold(_bd(i))
+    for i in range(6, 10):
+        ledger.fold(_bd(i, compute=0.2, straggler=0.6, host="h3"))
+    assert ledger.steps == 10
+    assert len(ledger.ring) == 4  # bounded
+    trend = ledger.trend()
+    assert trend["straggler_wait"] > 0      # taking over
+    assert trend["compute"] < 0             # receding
+    snap = ledger.snapshot()
+    assert snap["straggler_hosts"] == {"h3": 4}
+    assert set(snap["fractions"]) == set(CLASSES)
+    assert snap["steps"] == 10
+    for row in snap["last_steps"]:
+        assert set(row) == {"step", "total_s", "classes", "slowest_host",
+                            "n_hosts"}
+    assert "straggler" in ledger.format() or "critical path" in ledger.format()
+
+
+# =============================================================================
+# Live recorder
+# =============================================================================
+
+
+def test_recorder_recovers_emulated_skew():
+    injected = {"h0": 0.0, "h1": 0.12, "h2": -0.08, "h3": 0.04}
+    rec = TimelineRecorder(emit_events=False, emulated_skew_s=injected)
+    for cid in range(8):
+        for host in injected:
+            rec.note_collective(host, cid, fn="fleet_step", step=cid)
+    ests = rec.skew_estimates()
+    want = _centered(injected)
+    assert set(ests) == set(injected)
+    for host, est in ests.items():
+        assert abs(est.offset_s - want[host]) < 5e-3, host
+        assert not est.outlier
+    health = rec.health_state()
+    assert health["hosts"] == 4
+    assert health["min_confidence"] >= 0.5
+    assert health["outlier_hosts"] == []
+    dbg = rec.debug_state()
+    assert dbg["enabled"] and set(dbg) == {"enabled", "ledger", "skew",
+                                           "crosscheck", "health"}
+
+
+def test_recorder_seeded_straggler_trips_bottleneck_shift():
+    # Satellite (c): a seeded straggler fixture must trip bottleneck_shift
+    # naming the right host through the DetectorBank feed.
+    bank = DetectorBank(DetectorConfig(
+        critpath_min_steps=3, critpath_straggler_frac=0.2,
+        critpath_consecutive=2, critpath_cooldown=0,
+    ))
+    rec = TimelineRecorder(emit_events=False, bank=bank,
+                           host_label=lambda h: f"host{h}")
+    for step in range(10):
+        spans = {h: {"total_s": 0.10, "ici_s": 0.01, "stall_s": 0.005}
+                 for h in range(4)}
+        if step >= 4:
+            spans[3] = dict(spans[3], total_s=0.25)  # host 3 lags
+        bd = rec.record_step(step, spans)
+        assert bd is not None
+    shifts = [a for a in bank.recent_anomalies()
+              if a.kind == "bottleneck_shift"]
+    assert shifts, "seeded straggler did not trip bottleneck_shift"
+    named = [a for a in shifts if a.detector == "critpath_straggler_band"]
+    assert named and all(a.suspect_host == "host3" for a in named)
+    assert rec.ledger.snapshot()["straggler_hosts"].get(3, 0) >= 5
+
+
+def test_bank_dominant_flip_raises_fleet_level_anomaly():
+    bank = DetectorBank(DetectorConfig(
+        critpath_min_steps=3, critpath_consecutive=2, step_alpha=0.6,
+    ))
+    for step in range(4):
+        bank.note_critpath_step(step, {"compute": 0.8, "exposed_ici": 0.2})
+    for step in range(4, 12):
+        bank.note_critpath_step(step, {"compute": 0.1, "exposed_ici": 0.9})
+    doms = [a for a in bank.recent_anomalies()
+            if a.detector == "critpath_dominant"]
+    assert doms, "dominant-class flip did not raise bottleneck_shift"
+    assert doms[0].kind == "bottleneck_shift"
+    assert doms[0].fn == "compute->exposed_ici"
+    assert doms[0].suspect_host is None  # fleet-level: any decision may cite
+
+
+def test_bank_critpath_cooldown_rearm():
+    def run(cooldown):
+        bank = DetectorBank(DetectorConfig(
+            critpath_min_steps=2, critpath_straggler_frac=0.2,
+            critpath_consecutive=2, critpath_cooldown=cooldown,
+        ))
+        for step in range(20):
+            bank.note_critpath_step(step, {"compute": 0.4,
+                                           "straggler_wait": 0.6},
+                                    slowest_host="h1")
+        return sum(1 for a in bank.recent_anomalies()
+                   if a.detector == "critpath_straggler_band")
+
+    # cooldown=0 re-alerts every `critpath_consecutive` steps while the
+    # violation persists; a long cooldown collapses the run to one alert.
+    assert run(0) > run(16) >= 1
+
+
+# =============================================================================
+# Skew-corrected merge + offline assembly
+# =============================================================================
+
+
+def test_merge_event_logs_offsets_fix_cross_host_ordering(tmp_path):
+    # Host 2's clock runs 0.8 s ahead: its event at true time 10.5 is
+    # stamped 11.3, sorting after host 1's event at true 11.0. The offsets
+    # map restores causal order without rewriting record contents.
+    log1 = tmp_path / "host1.jsonl"
+    log2 = tmp_path / "host2.jsonl"
+    log1.write_text(
+        json.dumps({"kind": "step_time", "host": 1, "pid": 1, "seq": 0,
+                    "ts": 10.0, "step": 0}) + "\n"
+        + json.dumps({"kind": "step_time", "host": 1, "pid": 1, "seq": 1,
+                      "ts": 11.0, "step": 1}) + "\n")
+    log2.write_text(
+        json.dumps({"kind": "step_time", "host": 2, "pid": 2, "seq": 0,
+                    "ts": 11.3, "step": 0}) + "\n")
+    paths = [str(log1), str(log2)]
+    unaligned, diags = merge_event_logs(paths)
+    assert not diags
+    assert [r["host"] for r in unaligned] == [1, 1, 2]  # misordered
+    aligned, _ = merge_event_logs(paths, offsets={2: 0.8})
+    assert [r["host"] for r in aligned] == [1, 2, 1]    # causal order
+    assert aligned[1]["ts"] == 11.3  # ordering only; ts not rewritten
+
+
+def test_assemble_timeline_offline_twin():
+    injected = {"h0": 0.0, "h1": 0.09}
+    records = _barrier_records(injected, 8, spacing=1.0)
+    for r in records:
+        r["step"] = r["cid"]
+        r["in_slice_s"] = 0.01
+        r["cross_slice_s"] = 0.004
+    for i in range(8):
+        for host in injected:
+            records.append({"kind": "step_time", "host": host, "step": i,
+                            "ts": 1_000.0 + i, "fn": "train_step",
+                            "s": 0.11 if (host == "h1" and i >= 4) else 0.08})
+    records.append({"kind": "snapshot", "host": "h0", "step": 2,
+                    "ts": 1_002.0, "stall_ms": 6.0})
+    ledger, breakdowns, ests = ledger_from_records(records)
+    assert ledger.steps == len(breakdowns) == 8
+    assert abs(ests["h1"].offset_s - ests["h0"].offset_s
+               - 0.09) < 5e-3  # pairwise skew recovered
+    late = [bd for bd in breakdowns if bd.step >= 4]
+    assert all(bd.slowest_host == "h1" for bd in late)
+    assert all(bd.classes["straggler_wait"] > 0 for bd in late)
+    assert all(sum(bd.classes.values()) == pytest.approx(bd.total_s)
+               for bd in breakdowns)
+    assert breakdowns[2].classes["stall"] > 0 or \
+        breakdowns[2].slowest_host == "h1"  # stall charged when on-path
+
+
+# =============================================================================
+# Static wire split + cross-check
+# =============================================================================
+
+
+def test_split_static_wire_tiering():
+    site = lambda us, size: types.SimpleNamespace(wire_us=us, group_size=size)
+    out = split_static_wire(
+        [site(60.0, 4), site(30.0, 16), site(10.0, None)],
+        devices_per_slice=4,
+    )
+    assert out["ici_us"] == pytest.approx(60.0)   # fits in one slice
+    assert out["dcn_us"] == pytest.approx(40.0)   # larger or unknown group
+    assert out["ici_frac"] + out["dcn_frac"] == pytest.approx(1.0)
+    empty = split_static_wire([], devices_per_slice=4)
+    assert empty["ici_frac"] == empty["dcn_frac"] == 0.0
+
+
+def test_crosscheck_static_vs_measured():
+    rec = TimelineRecorder(emit_events=False)
+    rec.set_static_wire(0.10, 0.05, static_exposed_pct=15.0)
+    rec.predicted_exposed_pct = 15.0
+    sp = rec.static_spans(1.0)
+    assert sp["ici_s"] == pytest.approx(0.10)
+    assert sp["compute_s"] == pytest.approx(0.85)
+    for step in range(6):
+        rec.record_step(step, {
+            "h0": dict(sp, total_s=1.0),
+            "h1": dict(sp, total_s=1.0),
+        })
+    cc = rec.crosscheck()
+    assert cc["measured_exposed_pct"] == pytest.approx(15.0, abs=0.1)
+    assert abs(cc["delta_static_pct"]) < 0.1
+    assert abs(cc["delta_predicted_pct"]) < 0.1
+
+
+# =============================================================================
+# /healthz component + module lifecycle
+# =============================================================================
+
+
+def test_healthz_timeline_component_degrades():
+    from thunder_tpu.observability.opsplane import health_verdict
+
+    assert "timeline" not in health_verdict()["components"]  # not armed
+    rec = tl_mod.enable(emit_events=False)
+    rec.record_step(0, {"solo": {"total_s": 0.1}})
+    comp = health_verdict()["components"]["timeline"]
+    assert comp["status"] == "degraded"  # <2 hosts: nothing to decompose
+    assert comp["hosts"] == 1
+    injected = {"h0": 0.0, "h1": 0.03}
+    rec = tl_mod.enable(emit_events=False, emulated_skew_s=injected)
+    for cid in range(8):
+        for host in injected:
+            rec.note_collective(host, cid)
+    rec.record_step(0, {h: {"total_s": 0.1} for h in injected})
+    comp = health_verdict()["components"]["timeline"]
+    assert comp["status"] == "ok"
+    assert comp["hosts"] == 2 and comp["steps"] == 1
+
+
+def test_module_lifecycle():
+    assert tl_mod.current() is None
+    assert tl_mod.debug_state() == {"enabled": False}
+    assert tl_mod.health_state() is None
+    rec = tl_mod.enable(emit_events=False)
+    assert tl_mod.current() is rec
+    assert tl_mod.debug_state()["enabled"] is True
+    tl_mod.disable()
+    assert tl_mod.current() is None
+
+
+def test_monitor_facades():
+    rec = monitor.critpath(emit_events=False)
+    assert tl_mod.current() is rec
+    rec.record_step(0, {"h0": {"total_s": 0.1}, "h1": {"total_s": 0.12}})
+    report = monitor.critpath_report()
+    assert "critical path" in report
+    monitor.shutdown_critpath()
+    assert tl_mod.current() is None
+
+
+# =============================================================================
+# Autopilot citation
+# =============================================================================
+
+
+def test_autopilot_cites_bottleneck_shift():
+    ap = Autopilot()
+    ap.note_anomaly({"anomaly": "bottleneck_shift", "severity": "warn",
+                     "ts": time.time(), "value": 0.3, "baseline": 0.06,
+                     "suspect_host": "slice1"})
+    d = ap.decide(Signal("slice_loss", step=10, suspect_host="slice1"))
+    cited = d.signal.evidence.get("anomaly")
+    assert cited and cited["anomaly"] == "bottleneck_shift"
+    assert cited["suspect_host"] == "slice1"
+    # A decision naming a different host must NOT cite the host-matched
+    # anomaly (strikes would land on the wrong ledger).
+    d2 = ap.decide(Signal("slice_loss", step=11, suspect_host="slice0"))
+    assert "anomaly" not in (d2.signal.evidence or {})
+
+
+# =============================================================================
+# perf_report gate
+# =============================================================================
+
+
+def _good_round():
+    return ("CRITPATH_r01", {
+        "_metric_name": "critpath_exposed_pct",
+        "critpath_steps": 40, "critpath_nonzero_classes": 5,
+        "critpath_frac_sum": 1.0, "critpath_skew_recovery_err_ms": 3.2,
+        "critpath_skew_min_confidence": 0.9,
+        "critpath_skew_outlier_hosts": 0,
+        "critpath_straggler_host_match": 1,
+        "critpath_bottleneck_shift_anomalies": 3,
+        "critpath_cited_decisions": 1,
+        "critpath_delta_static_pct": 1.5,
+    })
+
+
+def test_critpath_gate_passes_good_round():
+    assert _critpath_failures(_good_round()) == []
+    # Non-critpath rounds are out of scope for this gate.
+    assert _critpath_failures(("SOAK_r01", {"_metric_name": "goodput"})) == []
+
+
+@pytest.mark.parametrize("field,bad", [
+    ("critpath_steps", 2),
+    ("critpath_nonzero_classes", 4),
+    ("critpath_frac_sum", 1.2),
+    ("critpath_skew_recovery_err_ms", 60.0),
+    ("critpath_skew_min_confidence", 0.2),
+    ("critpath_skew_outlier_hosts", 1),
+    ("critpath_straggler_host_match", 0),
+    ("critpath_bottleneck_shift_anomalies", 0),
+    ("critpath_cited_decisions", 0),
+    ("critpath_delta_static_pct", 20.0),
+])
+def test_critpath_gate_fails_each_invariant(field, bad):
+    label, m = _good_round()
+    m[field] = bad
+    assert _critpath_failures((label, m)), field
+
+
+def test_critpath_noise_floors_and_direction():
+    assert noise_floor("value", "critpath_exposed_pct") == 5.0
+    assert noise_floor("critpath_skew_recovery_err_ms",
+                       "critpath_exposed_pct") == 10.0
+    assert noise_floor("critpath_measured_exposed_pct",
+                       "critpath_exposed_pct") == 5.0
+    # The headline value is a time-like share: lower is better.
+    assert metric_direction("value", "critpath_exposed_pct") == -1
+    # Per-class fractions are descriptive, not gated.
+    assert metric_direction("critpath_straggler_wait_frac",
+                            "critpath_exposed_pct") is None

@@ -97,6 +97,14 @@ SCHEMA: dict[str, frozenset] = {
     # | kernel_regression), not a new one.
     "roofline_probe": frozenset({"step", "ops", "probe_s"}),
     "profile_degraded": frozenset({"reason"}),
+    # Fleet critical-path ledger (ISSUE 20; docs/observability.md "fleet
+    # timeline"): one rendezvous record per collective completion (the
+    # clock-alignment anchor; optional in_slice_s/cross_slice_s carry the
+    # federation's per-tier wire legs), and one per-step critical-path
+    # breakdown from the timeline recorder. bottleneck_shift verdicts ride
+    # the existing `anomaly` kind, like the roofline's drift verdicts.
+    "collective": frozenset({"fn", "cid", "s"}),
+    "critpath_step": frozenset({"step", "total_s", "classes", "slowest_host"}),
 }
 _COMMON = frozenset({"v", "ts", "seq", "kind"})
 
@@ -193,7 +201,10 @@ def _parse_log_lines(path: str, diags: list[Diagnostic]) -> list[tuple[int, dict
     return out
 
 
-def merge_event_logs(paths: list[str]) -> tuple[list[dict], list[Diagnostic]]:
+def merge_event_logs(
+    paths: list[str],
+    offsets: Optional[dict] = None,
+) -> tuple[list[dict], list[Diagnostic]]:
     """Merge several per-host JSONL logs (multi-host jobs write one log per
     process; every record carries ``pid``/``host`` — observability/events.py)
     into one deterministically-ordered stream.
@@ -201,7 +212,18 @@ def merge_event_logs(paths: list[str]) -> tuple[list[dict], list[Diagnostic]]:
     Ordering is stable across re-runs of the merge: (ts, host, pid, seq) —
     wall-clock first so interleaved compiles read chronologically, then
     writer identity, then the writer's own monotonic ``seq`` to break
-    same-timestamp ties. Returns (records, parse diagnostics)."""
+    same-timestamp ties. Returns (records, parse diagnostics).
+
+    **Caveat — unaligned clocks.** Each host stamps ``ts`` from its own
+    wall clock; without alignment, cross-host ordering under skew silently
+    misorders causally-related records (host B's collective *completion*
+    can sort before host A's *entry* into the same barrier). Pass
+    ``offsets`` — ``{host: seconds the host's clock runs ahead of the
+    fleet}``, e.g. from
+    ``observability.timeline.estimate_skew``/``offsets_for_merge`` — to
+    sort on skew-corrected time (``ts − offset``). Record contents are not
+    rewritten, only the ordering; use ``timeline.apply_offsets`` to rewrite
+    ``ts`` itself."""
     def num(v, cast) -> float:
         # A record with a non-numeric ts/host/pid/seq is still one record:
         # the schema validator downstream flags it; the merge must not die.
@@ -212,11 +234,13 @@ def merge_event_logs(paths: list[str]) -> tuple[list[dict], list[Diagnostic]]:
 
     diags: list[Diagnostic] = []
     records: list[tuple[tuple, int, dict]] = []
+    offsets = offsets or {}
     for path in paths:
         for lineno, rec in _parse_log_lines(path, diags):
             if isinstance(rec, dict):
+                off = offsets.get(rec.get("host")) or 0.0
                 key = (
-                    num(rec.get("ts"), float),
+                    num(rec.get("ts"), float) - num(off, float),
                     num(rec.get("host"), int),
                     num(rec.get("pid"), int),
                     num(rec.get("seq"), int),

@@ -230,3 +230,38 @@ def shutdown_roofline() -> None:
     from thunder_tpu.observability import roofline as roofline_mod
 
     roofline_mod.disable()
+
+
+def critpath(**options):
+    """Arm the fleet critical-path timeline recorder (ISSUE 20): per-step
+    host spans fold into a skew-aligned fleet timeline whose critical path
+    decomposes into typed classes (compute / exposed-ICI / exposed-DCN /
+    straggler-wait / stall / idle), exported as
+    ``thunder_tpu_critpath_fraction{class=}`` gauges and streamed into the
+    ops-plane detectors as ``bottleneck_shift`` anomalies. Fleet drivers
+    feed the returned recorder (``record_step``, ``note_collective`` —
+    ``resilience/federation.run_federated_training`` does this when handed
+    ``timeline=``); ``options`` forward to
+    ``observability.timeline.enable`` (bank, emulated_skew_s, ...)."""
+    from thunder_tpu.observability import timeline as timeline_mod
+
+    return timeline_mod.enable(**options)
+
+
+def critpath_report() -> Optional[str]:
+    """The live fleet critical-path ledger as a printable report (EWMA
+    class fractions + trend, per-host clock-skew estimates with
+    confidence, the static-vs-measured exposed-collective cross-check) —
+    the in-process spelling of ``/debug/critpath``. None when no timeline
+    recorder is installed."""
+    from thunder_tpu.observability import timeline as timeline_mod
+
+    recorder = timeline_mod.current()
+    return recorder.format_report() if recorder is not None else None
+
+
+def shutdown_critpath() -> None:
+    """Uninstall the process-wide timeline recorder."""
+    from thunder_tpu.observability import timeline as timeline_mod
+
+    timeline_mod.disable()

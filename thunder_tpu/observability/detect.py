@@ -47,6 +47,12 @@ slice_spread       slowest slice mean / cross-slice median past
                    whole slice lagging the federation behind its DCN link,
                    attributed as ``suspect_host="slice<N>"`` so the
                    autopilot's strike ledger accumulates against the slice
+bottleneck_shift   the fleet critical path's dominant time class flipped
+                   (compute ↔ exposed wire ↔ straggler-wait ...), or the
+                   straggler-wait fraction left its band for consecutive
+                   steps — fed per step by the timeline recorder (ISSUE
+                   20), naming the slowest host so the strike ledger
+                   accumulates against it
 =================  ==========================================================
 
 Module-top imports are stdlib-only (the bank is installed from the event
@@ -375,6 +381,18 @@ class DetectorConfig:
     roofline_band_factor: float = 1.5
     roofline_consecutive: int = 2
     roofline_min_samples: int = 3
+    # Fleet critical-path ledger (ISSUE 20): bottleneck_shift fires when
+    # the EWMA-dominant time class flips after warmup, or the
+    # straggler-wait fraction exceeds its absolute band (naming the slowest
+    # host into the autopilot strike ledger).
+    critpath_min_steps: int = 6
+    critpath_straggler_frac: float = 0.25
+    critpath_consecutive: int = 2
+    # The critpath feed is per fleet STEP (the spread detectors see one
+    # sample per host per step), so its re-arm cadence gets its own knob;
+    # None inherits ``cooldown``. 0 = re-alert every ``critpath_consecutive``
+    # steps while the band violation persists.
+    critpath_cooldown: Optional[int] = None
     # Samples a tripped detector stays quiet before re-arming (one drift =
     # one anomaly, then periodic re-alerts while it persists).
     cooldown: int = 16
@@ -445,6 +463,10 @@ class DetectorBank:
         self._slice_hits = 0
         self._slice_quiet = 0
         self._roofline: dict[str, BandDetector] = {}
+        self._critpath = {
+            "ewma": {}, "steps": 0, "dom": None, "dom_hits": 0,
+            "dom_quiet": 0, "strag_hits": 0, "strag_quiet": 0,
+        }
         self.anomalies: deque = deque(maxlen=self.config.max_anomalies)
         self.consumed = 0
 
@@ -616,6 +638,79 @@ class DetectorBank:
         for a in raised:
             self._publish(a)
 
+    def note_critpath_step(self, step: int, fractions: dict, *,
+                           slowest_host: Optional[Any] = None) -> None:
+        """Direct per-step feed from the fleet timeline recorder (ISSUE
+        20): class fractions of one step's critical path. Two triggers
+        raise ``bottleneck_shift``:
+
+        - the EWMA-dominant class flips after ``critpath_min_steps`` warmup
+          (``fn`` carries ``old->new``; fleet-level, so no suspect host —
+          any relevant autopilot decision may cite it);
+        - the straggler-wait fraction exceeds ``critpath_straggler_frac``
+          for ``critpath_consecutive`` steps, naming ``slowest_host`` so
+          the strike ledger accumulates against the lagging host."""
+        raised: list[Anomaly] = []
+        cfg = self.config
+        cp_cooldown = (cfg.cooldown if cfg.critpath_cooldown is None
+                       else cfg.critpath_cooldown)
+        with self._lock:
+            cp = self._critpath
+            alpha = cfg.step_alpha
+            for c, f in fractions.items():
+                try:
+                    f = float(f)
+                except (TypeError, ValueError):
+                    continue
+                prev = cp["ewma"].get(c)
+                cp["ewma"][c] = f if prev is None else prev + alpha * (f - prev)
+            cp["steps"] += 1
+            if cp["steps"] >= cfg.critpath_min_steps and cp["ewma"]:
+                window = [round(cp["ewma"][c], 4) for c in sorted(cp["ewma"])]
+                dom = max(cp["ewma"], key=lambda c: cp["ewma"][c])
+                if cp["dom"] is None:
+                    cp["dom"] = dom
+                elif dom != cp["dom"]:
+                    if cp["dom_quiet"] > 0:
+                        cp["dom_quiet"] -= 1
+                    else:
+                        cp["dom_hits"] += 1
+                        if cp["dom_hits"] >= cfg.critpath_consecutive:
+                            raised.append(self._anomaly(
+                                "bottleneck_shift", "critpath_dominant",
+                                {"value": cp["ewma"][dom],
+                                 "baseline": cp["ewma"].get(cp["dom"], 0.0),
+                                 "window": window},
+                                fn=f"{cp['dom']}->{dom}",
+                            ))
+                            cp["dom"] = dom
+                            cp["dom_hits"] = 0
+                            cp["dom_quiet"] = cp_cooldown
+                else:
+                    cp["dom_hits"] = 0
+                try:
+                    strag = float(fractions.get("straggler_wait") or 0.0)
+                except (TypeError, ValueError):
+                    strag = 0.0
+                if strag <= cfg.critpath_straggler_frac:
+                    cp["strag_hits"] = 0
+                elif cp["strag_quiet"] > 0:
+                    cp["strag_quiet"] -= 1
+                else:
+                    cp["strag_hits"] += 1
+                    if cp["strag_hits"] >= cfg.critpath_consecutive:
+                        cp["strag_hits"] = 0
+                        cp["strag_quiet"] = cp_cooldown
+                        raised.append(self._anomaly(
+                            "bottleneck_shift", "critpath_straggler_band",
+                            {"value": strag,
+                             "baseline": cfg.critpath_straggler_frac,
+                             "window": window},
+                            suspect_host=slowest_host,
+                        ))
+        for a in raised:
+            self._publish(a)
+
     def _on_recompile(self) -> list:
         hit = self._recompiles.tick()
         if not hit:
@@ -719,6 +814,8 @@ class DetectorBank:
                 "step_streams": sorted(self._step),
                 "roofline_streams": len(self._roofline),
                 "slices": len(self._slice_acc),
+                "critpath_steps": self._critpath["steps"],
+                "critpath_dominant": self._critpath["dom"],
                 "recompile_window": len(self._recompiles._ticks),
                 "anomalies": [
                     dict(a.as_event_fields(), ts=round(a.ts, 3))

@@ -226,6 +226,10 @@ def health_verdict(plane: Optional["OpsPlane"] = None, *,
     - ``profile`` — profiler bracket captures (ISSUE 19): any capture
       that degraded to wall clock (missing plugin) → degraded, since
       every roofline duty-cycle probe behind it measured nothing;
+    - ``timeline`` — the fleet critical-path recorder (ISSUE 20) when
+      armed: fewer than two reporting hosts (nothing to cross-host join)
+      or low clock-alignment confidence → degraded; absent when no
+      recorder is installed;
     - ``anomalies`` — detector verdicts within ``anomaly_window_s``:
       any warn → degraded, any critical → critical."""
     plane = plane if plane is not None else current()
@@ -333,6 +337,24 @@ def health_verdict(plane: Optional["OpsPlane"] = None, *,
          f"{degraded_caps} profiler capture(s) degraded to wall clock "
          "(no profiler plugin)")
 
+    # Fleet timeline (ISSUE 20): a silently dead critical-path recorder
+    # must be as visible as a missing profiler — degraded when fewer than
+    # two hosts ever reported (no cross-host path to decompose) or when the
+    # weakest non-outlier clock alignment is low-confidence.
+    from thunder_tpu.observability import timeline as timeline_mod
+
+    tl = timeline_mod.health_state()
+    if tl is not None:
+        conf = tl.get("min_confidence")
+        tl_status = "ok"
+        if tl["hosts"] < 2:
+            tl_status = "degraded"
+        elif conf is not None and conf < 0.5:
+            tl_status = "degraded"
+        comp("timeline", tl_status, tl,
+             f"fleet timeline degraded: hosts={tl['hosts']}, "
+             f"alignment confidence={conf}")
+
     recent: list = []
     if plane is not None and plane.bank is not None:
         recent = plane.bank.recent_anomalies(within_s=anomaly_window_s)
@@ -393,6 +415,9 @@ def debug_state(plane: Optional["OpsPlane"] = None) -> dict:
     from thunder_tpu.observability import roofline as roofline_mod
 
     out["roofline"] = roofline_mod.debug_state()
+    from thunder_tpu.observability import timeline as timeline_mod
+
+    out["timeline"] = timeline_mod.debug_state()
     return out
 
 
@@ -450,6 +475,13 @@ class OpsServer:
 
                         self._send(200, json.dumps(
                             roofline_mod.debug_state(), default=str),
+                            "application/json")
+                    elif route == "/debug/critpath":
+                        from thunder_tpu.observability import (
+                            timeline as timeline_mod)
+
+                        self._send(200, json.dumps(
+                            timeline_mod.debug_state(), default=str),
                             "application/json")
                     elif route == "/debug/flightrec":
                         rec = outer.plane.recorder

@@ -312,14 +312,19 @@ class Autopilot:
     # drifting step backs the hang/loss ladders; a recompile storm backs
     # the compile-pressure ladder.
     _ANOMALY_RELEVANCE = {
-        "collective_hang": ("step_time_drift", "goodput_drop", "host_spread"),
-        "host_loss": ("step_time_drift", "goodput_drop", "host_spread"),
-        "host_unhealthy": ("step_time_drift", "goodput_drop", "host_spread"),
+        "collective_hang": ("step_time_drift", "goodput_drop", "host_spread",
+                            "bottleneck_shift"),
+        "host_loss": ("step_time_drift", "goodput_drop", "host_spread",
+                      "bottleneck_shift"),
+        "host_unhealthy": ("step_time_drift", "goodput_drop", "host_spread",
+                           "bottleneck_shift"),
         "oom": ("recompile_storm",),
         "compile_fail": ("recompile_storm",),
         # A DCN-tier spread verdict is evidence for the slice ladder: the
-        # slow slice was already a named suspect before it died (ISSUE 18).
-        "slice_loss": ("slice_spread", "goodput_drop"),
+        # slow slice was already a named suspect before it died (ISSUE 18);
+        # so is the fleet timeline's bottleneck_shift — the critical path
+        # had already moved onto straggler-wait / exposed DCN (ISSUE 20).
+        "slice_loss": ("slice_spread", "goodput_drop", "bottleneck_shift"),
     }
 
     def _cite_anomaly(self, signal: Signal) -> Optional[dict]:

@@ -318,6 +318,7 @@ def run_federated_training(
     recover_after: Optional[int] = None,
     on_step: Optional[Callable] = None,
     slice_step_time: Optional[Callable] = None,
+    timeline=None,
     warm_start: bool = True,
     max_recoveries: int = 32,
 ) -> tuple[Any, FleetReport]:
@@ -346,6 +347,12 @@ def run_federated_training(
       per-step duration (base step + its ``slice_slow`` inflation) — the
       feed for the cross-slice spread detector
       (``observability/detect.py``).
+    - ``timeline`` is an armed
+      :class:`~thunder_tpu.observability.timeline.TimelineRecorder`: each
+      step the driver feeds it per-slice spans (work + snapshot stall +
+      measured dispatch gap, wire legs from the recorder's static split)
+      and one lockstep-barrier ``collective`` rendezvous record per slice
+      — the fleet critical-path ledger's entire input (ISSUE 20).
 
     A ``slice_flap`` injection scripts the victim through a fail/recover/
     fail/recover loop on consecutive steps — faster than any sane
@@ -452,95 +459,141 @@ def run_federated_training(
         step_fn = _build(mesh, width)
         report.regrows += 1
 
-    while step < n_steps:
-        # ---- chaos seams + scripted recoveries at the step boundary ----
-        for kind, sid in flap_script.pop(step, []):
-            if kind == "lose":
-                d = controller.on_slice_loss(sid, step, reason="slice_flap")
+    def _feed_timeline(at_step: int, base_s: float, delays: dict,
+                       stall_s: float, gap_s: float) -> None:
+        # Per-slice spans for the critical-path ledger: each slice's own
+        # work is the base step plus its chaos inflation; the snapshot
+        # stall and the driver's dispatch gap are uniform (every host
+        # snapshots its own shard / waits on the same loop). Wire legs come
+        # from the recorder's static split of the compute work — measured
+        # per-leg timing is a hardware-fleet capability; the emulated fleet
+        # prices it statically, which is exactly what keeps the recorder's
+        # static-vs-measured cross-check falsifiable.
+        spans: dict = {}
+        wall = base_s + (max(delays.values()) if delays else 0.0) + stall_s
+        for sid, d in delays.items():
+            sp = dict(timeline.static_spans(base_s))
+            sp["total_s"] = base_s + d + stall_s + gap_s
+            sp["stall_s"] = stall_s
+            spans[sid] = sp
+            # The lockstep barrier ending the step is the rendezvous
+            # anchor every slice leaves together — one collective record
+            # per slice, `s` = the wire time this slice spent in it.
+            timeline.note_collective(
+                sid, at_step, fn="fleet_step",
+                s=max(0.0, wall - (base_s + d + stall_s)),
+                in_slice_s=sp.get("ici_s", 0.0),
+                cross_slice_s=sp.get("dcn_s", 0.0),
+                step=at_step,
+            )
+        timeline.record_step(at_step, spans)
+
+    # Installed for the loop's duration (the run_training pattern): the
+    # DetectorBank publishes every anomaly to autopilot.current(), and
+    # the controller's decisions must cite that evidence ring -- an
+    # uninstalled autopilot would decide blind.
+    with autopilot.installed():
+        while step < n_steps:
+            iter_t0 = time.perf_counter()
+            # ---- chaos seams + scripted recoveries at the step boundary ----
+            for kind, sid in flap_script.pop(step, []):
+                if kind == "lose":
+                    d = controller.on_slice_loss(sid, step, reason="slice_flap")
+                    if d is not None:
+                        _apply_shrink(d, sid, step)
+                else:
+                    controller.on_slice_recovered(sid, step)
+            for sid, at in list(pending_recover.items()):
+                if step >= at:
+                    del pending_recover[sid]
+                    controller.on_slice_recovered(sid, step)
+            if partition_heal_at is not None:
+                if step >= partition_heal_at:
+                    partition_heal_at = None
+                    if stores:
+                        for st in stores:
+                            st.partitioned = False
+                else:
+                    report.partitioned_steps += 1
+
+            victim = chaos.slice_loss_at_step(step)
+            if victim is not None:
+                if report.shrinks + report.regrows >= max_recoveries:
+                    _halt(step, "max recoveries exceeded", None)
+                d = controller.on_slice_loss(victim, step)
                 if d is not None:
-                    _apply_shrink(d, sid, step)
-            else:
-                controller.on_slice_recovered(sid, step)
-        for sid, at in list(pending_recover.items()):
-            if step >= at:
-                del pending_recover[sid]
-                controller.on_slice_recovered(sid, step)
-        if partition_heal_at is not None:
-            if step >= partition_heal_at:
-                partition_heal_at = None
-                if stores:
-                    for st in stores:
-                        st.partitioned = False
-            else:
-                report.partitioned_steps += 1
+                    _apply_shrink(d, victim, step)
+                if recover_after:
+                    pending_recover[victim] = step + int(recover_after)
 
-        victim = chaos.slice_loss_at_step(step)
-        if victim is not None:
-            if report.shrinks + report.regrows >= max_recoveries:
-                _halt(step, "max recoveries exceeded", None)
-            d = controller.on_slice_loss(victim, step)
-            if d is not None:
-                _apply_shrink(d, victim, step)
-            if recover_after:
-                pending_recover[victim] = step + int(recover_after)
+            flapper = chaos.slice_flap_at_step(step)
+            if flapper is not None:
+                # Scripted flap: lose now, recover next step, re-fail the one
+                # after, recover again — two cycles inside any hysteresis
+                # window long enough to matter.
+                d = controller.on_slice_loss(flapper, step, reason="slice_flap")
+                if d is not None:
+                    _apply_shrink(d, flapper, step)
+                flap_script.setdefault(step + 1, []).append(("recover", flapper))
+                flap_script.setdefault(step + 2, []).append(("lose", flapper))
+                flap_script.setdefault(step + 3, []).append(("recover", flapper))
 
-        flapper = chaos.slice_flap_at_step(step)
-        if flapper is not None:
-            # Scripted flap: lose now, recover next step, re-fail the one
-            # after, recover again — two cycles inside any hysteresis
-            # window long enough to matter.
-            d = controller.on_slice_loss(flapper, step, reason="slice_flap")
-            if d is not None:
-                _apply_shrink(d, flapper, step)
-            flap_script.setdefault(step + 1, []).append(("recover", flapper))
-            flap_script.setdefault(step + 2, []).append(("lose", flapper))
-            flap_script.setdefault(step + 3, []).append(("recover", flapper))
+            rule = chaos.dcn_partition_at_step(step)
+            if rule is not None and stores:
+                for st in stores:
+                    st.partitioned = True
+                partition_heal_at = step + max(1, int(round(rule.delay_s)))
 
-        rule = chaos.dcn_partition_at_step(step)
-        if rule is not None and stores:
-            for st in stores:
-                st.partitioned = True
-            partition_heal_at = step + max(1, int(round(rule.delay_s)))
+            regrow = controller.poll(step)
+            if regrow is not None:
+                _apply_regrow(regrow, step)
 
-        regrow = controller.poll(step)
-        if regrow is not None:
-            _apply_regrow(regrow, step)
+            # ---- the training step ----
+            t0 = time.perf_counter()
+            state, loss = step_fn(state)
+            base_s = time.perf_counter() - t0
+            slow = 0.0
+            delays: dict = {}
+            for sid in ledger.active_slices():
+                d = chaos.slice_slow_delay(sid)
+                delays[sid] = d
+                if slice_step_time is not None:
+                    slice_step_time(sid, base_s + d)
+                slow = max(slow, d)
+            if slow:
+                # The fleet steps in lockstep: the slowest slice gates the step.
+                time.sleep(slow)
+            report.losses[step] = loss
+            report.steps_executed += 1
+            if width < full_width:
+                report.degraded_steps += 1
+            if on_step is not None:
+                on_step(step, loss, width)
 
-        # ---- the training step ----
-        t0 = time.perf_counter()
-        state, loss = step_fn(state)
-        base_s = time.perf_counter() - t0
-        slow = 0.0
-        for sid in ledger.active_slices():
-            d = chaos.slice_slow_delay(sid)
-            if slice_step_time is not None:
-                slice_step_time(sid, base_s + d)
-            slow = max(slow, d)
-        if slow:
-            # The fleet steps in lockstep: the slowest slice gates the step.
-            time.sleep(slow)
-        report.losses[step] = loss
-        report.steps_executed += 1
-        if width < full_width:
-            report.degraded_steps += 1
-        if on_step is not None:
-            on_step(step, loss, width)
-
-        done = step + 1
-        if done < n_steps:
-            want_disk = bool(save_every and done % save_every == 0)
-            want_snap = bool(snapshot_every and done % snapshot_every == 0)
-            if want_snap or want_disk:
-                async_flush = bool(getattr(manager, "async_flush", False))
-                snap = manager.snapshot(
-                    state, done, rng_seed=api._global_rng["seed"], mesh=mesh,
-                    flush=want_disk and async_flush,
-                )
-                _fan_out(snap)
-                if want_disk and not async_flush:
-                    manager.save(state, done,
-                                 rng_seed=api._global_rng["seed"], mesh=mesh)
-        step = done
+            done = step + 1
+            snap_stall_s = 0.0
+            if done < n_steps:
+                want_disk = bool(save_every and done % save_every == 0)
+                want_snap = bool(snapshot_every and done % snapshot_every == 0)
+                if want_snap or want_disk:
+                    t_snap = time.perf_counter()
+                    async_flush = bool(getattr(manager, "async_flush", False))
+                    snap = manager.snapshot(
+                        state, done, rng_seed=api._global_rng["seed"], mesh=mesh,
+                        flush=want_disk and async_flush,
+                    )
+                    _fan_out(snap)
+                    if want_disk and not async_flush:
+                        manager.save(state, done,
+                                     rng_seed=api._global_rng["seed"], mesh=mesh)
+                    snap_stall_s = time.perf_counter() - t_snap
+            if timeline is not None and delays:
+                # The dispatch gap: loop wall time not accounted to work,
+                # lockstep wait, or the snapshot stall — the step's idle class.
+                gap_s = max(0.0, (time.perf_counter() - iter_t0)
+                            - base_s - slow - snap_stall_s)
+                _feed_timeline(step, base_s, delays, snap_stall_s, gap_s)
+            step = done
 
     # Drain any still-cooling slice the caller wants resolved via poll()
     # after the run; the report captures where the fleet ended up.
